@@ -1,0 +1,24 @@
+"""repic_tpu — a TPU-native consensus particle-picking framework.
+
+A ground-up JAX/XLA re-architecture of the capabilities of REPIC
+(REliable PIcking by Consensus; reference: /root/reference/README.md:7):
+ensemble consensus of k independent cryo-EM particle pickers via
+pairwise Jaccard overlap, k-partite clique enumeration, and
+maximum-weight clique-cover optimization — plus iterative ensemble
+retraining with an in-framework JAX CNN picker.
+
+Instead of the reference's sequential per-micrograph Python loops
+(get_cliques.py:108) and a commercial ILP solver (run_ilp.py:50-63),
+the compute path here is a single batched, masked tensor program:
+
+    shard_map(vmap(consensus_one_micrograph)) over the micrograph axis
+
+with a vmapped pairwise-IoU kernel, tensorized k-partite clique
+enumeration (anchored neighbor-list joins instead of Bron-Kerbosch),
+and a parallel greedy-dominance set-packing solver (with an exact
+branch-and-bound CPU oracle for validation).
+"""
+
+from repic_tpu.__version__ import __version__
+
+__all__ = ["__version__"]

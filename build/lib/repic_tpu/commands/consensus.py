@@ -1,0 +1,56 @@
+"""``consensus`` subcommand — the fused single-pass TPU fast path.
+
+New capability beyond the reference CLI: runs both consensus phases
+(clique enumeration + solver) as ONE batched jitted program sharded
+over the device mesh, reading picker BOX directories and writing
+consensus BOX files directly — no pickled intermediates.  This is the
+headline benchmark path (BASELINE.md north star: full EMPIAR-10017
+set end-to-end).  Use ``get_cliques``/``run_ilp`` when reference
+artifact compatibility or the exact solver is required.
+"""
+
+import json
+
+name = "consensus"
+
+
+def add_arguments(parser):
+    parser.add_argument("in_dir", help="directory of picker subdirectories")
+    parser.add_argument("out_dir", help="output directory for BOX files")
+    parser.add_argument("box_size", type=int, help="box size (pixels)")
+    parser.add_argument(
+        "--num_particles", type=int, help="top-N particle cutoff"
+    )
+    parser.add_argument(
+        "--threshold", type=float, default=0.3, help="IoU edge threshold"
+    )
+    parser.add_argument(
+        "--max_neighbors", type=int, default=16,
+        help="static neighbor capacity of the clique enumerator",
+    )
+    parser.add_argument(
+        "--no_mesh", action="store_true", help="disable device-mesh sharding"
+    )
+
+
+def main(args):
+    from repic_tpu.pipeline.consensus import run_consensus_dir
+
+    stats = run_consensus_dir(
+        args.in_dir,
+        args.out_dir,
+        args.box_size,
+        threshold=args.threshold,
+        max_neighbors=args.max_neighbors,
+        num_particles=args.num_particles,
+        use_mesh=not args.no_mesh,
+    )
+    print(json.dumps(stats, default=str, indent=2))
+
+
+if __name__ == "__main__":
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    add_arguments(parser)
+    main(parser.parse_args())

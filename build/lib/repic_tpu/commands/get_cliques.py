@@ -1,0 +1,264 @@
+"""``get_cliques`` subcommand — consensus phase 1 (TPU-batched).
+
+CLI-compatible with the reference command of the same name
+(reference: repic/commands/get_cliques.py): same positional arguments,
+same on-disk artifact surface per micrograph —
+
+    {base}_weight_vector.pickle          float32 (n,)
+    {base}_consensus_coords.pickle       reps / sorted member lists
+    {base}_consensus_confidences.pickle  float32 (n,)
+    {base}_constraint_matrix.pickle      scipy COO (|V| x n)
+    {base}_runtime.tsv                   runtime, largest CC, #CC
+
+so the two phases stay independently re-runnable (checkpoint semantics
+of get_cliques.py:215-222) and either phase can interoperate with the
+reference's counterpart.  The compute, however, is one batched jitted
+program over all micrographs instead of a per-micrograph Python loop.
+
+Known divergence (documented, intentional): with ``--multi_out`` the
+reference compares 4-tuple raw coordinates against 3-tuple graph nodes
+when appending "unmatched" singletons (get_cliques.py:210-213), so its
+difference-set is always the *entire* particle list.  Here singletons
+are the particles genuinely absent from every clique — the documented
+intent ("vertices not found in chosen cliques", run_ilp.py:93-94).
+"""
+
+import os
+import pickle
+import time
+
+import numpy as np
+
+from repic_tpu.utils import box_io
+
+name = "get_cliques"
+
+
+def add_arguments(parser):
+    parser.add_argument(
+        "in_dir",
+        help="path to input directory containing subdirectories of "
+        "particle coordinate files",
+    )
+    parser.add_argument(
+        "out_dir",
+        help="path to output directory (WARNING - deleted if it exists)",
+    )
+    parser.add_argument(
+        "box_size", type=int, help="particle detection box size (pixels)"
+    )
+    parser.add_argument(
+        "--multi_out",
+        action="store_true",
+        help="output clique members sorted by picker name",
+    )
+    parser.add_argument(
+        "--get_cc",
+        action="store_true",
+        help="keep only cliques in the largest connected component",
+    )
+    parser.add_argument(
+        "--max_neighbors",
+        type=int,
+        default=16,
+        help="static per-pair neighbor capacity of the clique enumerator",
+    )
+    parser.add_argument(
+        "--no_mesh",
+        action="store_true",
+        help="disable sharding over the device mesh",
+    )
+
+
+def _vertex_tuples(ids, xy):
+    """(x, y, id) node tuples in the reference's vertex identity."""
+    return [
+        (float(x), float(y), int(i)) for (x, y), i in zip(xy, ids)
+    ]
+
+
+def main(args):
+    import shutil
+
+    import jax.numpy as jnp
+    from scipy.sparse import coo_matrix
+
+    from repic_tpu.ops.components import (
+        component_stats,
+        connected_component_labels,
+        largest_component_label,
+    )
+    from repic_tpu.parallel.batching import pad_batch
+    from repic_tpu.pipeline.consensus import run_consensus_batch
+
+    assert os.path.exists(args.in_dir), "Error - input directory does not exist"
+    if os.path.isdir(args.out_dir):
+        shutil.rmtree(args.out_dir)
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    pickers = box_io.discover_picker_dirs(args.in_dir)
+    assert pickers, "Error - no picker subdirectories found"
+    names = box_io.micrograph_names(os.path.join(args.in_dir, pickers[0]))
+    k = len(pickers)
+    print(f"Using {pickers[0]} BOX files as starting point")
+
+    t_start = time.time()
+    loaded = []
+    for mname in names:
+        sets = box_io.load_micrograph_set(args.in_dir, pickers, mname)
+        if sets is None:
+            print(
+                f"Skipping micrograph {mname} - not all methods have "
+                "picked particles..."
+            )
+            box_io.write_empty_box(
+                os.path.join(args.out_dir, mname + ".box")
+            )
+        else:
+            loaded.append((mname, sets))
+    if not loaded:
+        return
+
+    import jax
+
+    n_dev = 1 if args.no_mesh else len(jax.devices())
+    batch = pad_batch(loaded, pad_micrographs_to=n_dev)
+    res = run_consensus_batch(
+        batch,
+        args.box_size,
+        max_neighbors=args.max_neighbors,
+        use_mesh=not args.no_mesh,
+    )
+
+    # CC labels for --get_cc and the runtime-TSV stats.
+    cc_fn = jax.jit(
+        jax.vmap(
+            lambda xy, conf, mask: connected_component_labels(
+                xy, conf, mask, float(args.box_size)
+            )
+        )
+    )
+    labels_b, node_mask_b = cc_fn(
+        jnp.asarray(batch.xy), jnp.asarray(batch.conf), jnp.asarray(batch.mask)
+    )
+    labels_b = np.asarray(labels_b)
+    node_mask_b = np.asarray(node_mask_b)
+
+    n_cap = batch.capacity
+    # Global sequential particle ids across micrographs and pickers in
+    # processing order — the deterministic replacement for the
+    # reference's mutable ``box_id`` counter (common.py:23).
+    next_id = 0
+    per_micro_runtime = (time.time() - t_start) / max(len(loaded), 1)
+
+    for i, (mname, sets) in enumerate(loaded):
+        t0 = time.time()
+        counts = [s.n for s in sets]
+        id_base = [next_id + int(np.sum(counts[:p])) for p in range(k)]
+        next_id += int(np.sum(counts))
+
+        valid = np.asarray(res.valid[i])
+        member_idx = np.asarray(res.member_idx[i])[valid]  # (n, K)
+        w = np.asarray(res.w[i])[valid]
+        conf = np.asarray(res.confidence[i])[valid]
+        rep_slot = np.asarray(res.rep_slot[i])[valid]
+        rep_xy = np.asarray(res.rep_xy[i])[valid]
+
+        if args.get_cc:
+            keep_label = largest_component_label(
+                labels_b[i], node_mask_b[i]
+            )
+            anchor_labels = labels_b[i][0, member_idx[:, 0]]
+            keep = anchor_labels == keep_label
+            member_idx, w, conf = member_idx[keep], w[keep], conf[keep]
+            rep_slot, rep_xy = rep_slot[keep], rep_xy[keep]
+
+        n = len(w)
+        num_cc, max_cc, _ = component_stats(labels_b[i], node_mask_b[i])
+
+        # Vertex ids in the reference identity space.
+        node_id = member_idx + np.asarray(id_base)[None, :]  # (n, K)
+        node_xy = np.stack(
+            [sets[p].xy[member_idx[:, p]] for p in range(k)], axis=1
+        )  # (n, K, 2)
+
+        if args.multi_out:
+            coords_out = [list(pickers)]
+            for c in range(n):
+                coords_out.append(
+                    _vertex_tuples(node_id[c], node_xy[c])
+                )
+            if not args.get_cc:
+                in_cliques = [set() for _ in range(k)]
+                for c in range(n):
+                    for p in range(k):
+                        in_cliques[p].add(int(member_idx[c, p]))
+                for p in range(k):
+                    for j in range(counts[p]):
+                        if j not in in_cliques[p]:
+                            entry = [None] * k
+                            entry[p] = (
+                                float(sets[p].xy[j, 0]),
+                                float(sets[p].xy[j, 1]),
+                                int(id_base[p] + j),
+                            )
+                            coords_out.append(entry)
+        else:
+            rep_particle = member_idx[np.arange(n), rep_slot]
+            rep_ids = np.asarray(id_base)[rep_slot] + rep_particle
+            coords_out = _vertex_tuples(rep_ids, rep_xy)
+
+        # Constraint matrix over sorted participating vertices
+        # (reference sorts (x, y, id) tuples — get_cliques.py:164).
+        all_nodes = sorted(
+            {
+                (float(node_xy[c, p, 0]), float(node_xy[c, p, 1]), int(node_id[c, p]))
+                for c in range(n)
+                for p in range(k)
+            }
+        )
+        index = {node: r for r, node in enumerate(all_nodes)}
+        rows, cols = [], []
+        for c in range(n):
+            for p in range(k):
+                node = (
+                    float(node_xy[c, p, 0]),
+                    float(node_xy[c, p, 1]),
+                    int(node_id[c, p]),
+                )
+                rows.append(index[node])
+                cols.append(c)
+        a_mat = coo_matrix(
+            ([1] * len(cols), (rows, cols)), shape=(len(all_nodes), n)
+        )
+        print(f"--- {mname}: {n} cliques, {len(all_nodes)} vertices")
+
+        for label, val in zip(
+            [
+                "weight_vector",
+                "consensus_coords",
+                "consensus_confidences",
+                "constraint_matrix",
+            ],
+            [w.astype(np.float32), coords_out, conf.astype(np.float32), a_mat],
+        ):
+            with open(
+                os.path.join(args.out_dir, f"{mname}_{label}.pickle"), "wb"
+            ) as o:
+                pickle.dump(val, o, protocol=pickle.HIGHEST_PROTOCOL)
+
+        with open(
+            os.path.join(args.out_dir, f"{mname}_runtime.tsv"), "wt"
+        ) as o:
+            runtime = per_micro_runtime + (time.time() - t0)
+            o.write(
+                "\t".join(str(v) for v in [runtime, max_cc, num_cc]) + "\n"
+            )
+
+
+if __name__ == "__main__":
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    add_arguments(parser)
+    main(parser.parse_args())

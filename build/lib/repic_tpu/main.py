@@ -1,0 +1,60 @@
+"""``repic-tpu`` CLI dispatcher.
+
+Mirrors the reference's subcommand registration protocol — each
+command module exposes ``name``, ``add_arguments(parser)`` and
+``main(args)`` and is also runnable standalone
+(reference: repic/main.py:17-29) — with the reference's four
+subcommands plus TPU-native additions.
+"""
+
+import argparse
+import importlib
+
+import repic_tpu
+
+# Lazily-imported command modules (keeps `--version` fast and avoids
+# paying jax startup for --help).
+COMMAND_MODULES = [
+    "repic_tpu.commands.get_cliques",
+    "repic_tpu.commands.run_ilp",
+    "repic_tpu.commands.consensus",
+    "repic_tpu.commands.iter_config",
+]
+
+
+def build_parser():
+    parser = argparse.ArgumentParser(prog="repic-tpu")
+    parser.add_argument(
+        "--version",
+        action="version",
+        version=f"repic-tpu {repic_tpu.__version__}",
+    )
+    parser.add_argument(
+        "--platform",
+        choices=["tpu", "cpu"],
+        default=None,
+        help="force the JAX platform (e.g. cpu while the TPU is busy)",
+    )
+    subparsers = parser.add_subparsers(
+        title="commands", dest="command", required=True
+    )
+    for mod_name in COMMAND_MODULES:
+        module = importlib.import_module(mod_name)
+        sub = subparsers.add_parser(module.name)
+        module.add_arguments(sub)
+        sub.set_defaults(func=module.main)
+    return parser
+
+
+def main(argv=None):
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()

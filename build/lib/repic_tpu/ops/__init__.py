@@ -1,0 +1,12 @@
+from repic_tpu.ops.iou import pair_iou, pairwise_iou_matrix
+from repic_tpu.ops.cliques import enumerate_cliques, CliqueSet
+from repic_tpu.ops.solver import solve_greedy, solve_exact_py
+
+__all__ = [
+    "pair_iou",
+    "pairwise_iou_matrix",
+    "enumerate_cliques",
+    "CliqueSet",
+    "solve_greedy",
+    "solve_exact_py",
+]

@@ -1,0 +1,203 @@
+"""Tensorized k-partite clique enumeration.
+
+The reference builds a networkx graph per micrograph and enumerates
+*maximal* cliques with Bron-Kerbosch, keeping those of size exactly k
+(reference: repic/commands/get_cliques.py:49-56,140-165).  Because the
+overlap graph is k-partite (edges only connect different pickers), a
+size-k clique contains exactly one particle per picker and is always
+maximal — so the reference's "maximal cliques filtered to size k" is
+exactly the set of k-tuples (one particle per picker) whose C(k,2)
+pairwise IoUs all exceed the threshold.
+
+That observation turns clique enumeration into a fixed-shape tensor
+join, anchored on picker 0 (every k-clique has exactly one member
+there):
+
+1. for each other picker p, take the top-``max_neighbors`` IoU
+   neighbors of each anchor particle (a dense masked top_k — complete
+   as long as no anchor has more than ``max_neighbors`` overlaps above
+   threshold, which is geometrically bounded for IoU > 0.3 of
+   equal-size boxes; overflow is detected and reported);
+2. form the cartesian product of the k-1 neighbor lists per anchor —
+   ``(N, D^(k-1))`` candidate tuples;
+3. validate all cross-picker edges by gathering from the pairwise IoU
+   matrices.
+
+Everything is static-shape, mask-carried, and vmappable over the
+micrograph axis.
+
+Per-clique statistics reproduce the reference exactly:
+  * clique confidence = median of the k member confidences
+    (get_cliques.py:186-187);
+  * ILP weight w = confidence * median of the C(k,2) edge IoUs
+    (get_cliques.py:188-190);
+  * representative member = max weighted degree within the clique
+    (get_cliques.py:182-183).  Ties are broken by picker order here
+    (the reference inherits networkx insertion order; exact float ties
+    are vanishingly rare and tolerance-gated in tests).
+"""
+
+import itertools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from repic_tpu.ops.iou import pairwise_iou_matrix
+
+DEFAULT_THRESHOLD = 0.3  # reference: get_cliques.py:138
+
+
+class CliqueSet(NamedTuple):
+    """Padded set of candidate k-cliques for one micrograph.
+
+    ``C = N * max_neighbors**(k-1)`` is the static candidate capacity;
+    ``valid`` marks real cliques.
+    """
+
+    member_idx: jax.Array   # (C, K) int32 — per-picker particle index
+    valid: jax.Array        # (C,) bool
+    w: jax.Array            # (C,) float — ILP objective weight
+    confidence: jax.Array   # (C,) float — median member confidence
+    rep_slot: jax.Array     # (C,) int32 — picker slot of representative
+    rep_xy: jax.Array       # (C, 2) float — representative coordinates
+    max_adjacency: jax.Array  # () int32 — neighbor-list overflow probe
+
+    @property
+    def capacity(self) -> int:
+        return self.member_idx.shape[0]
+
+    @property
+    def num_pickers(self) -> int:
+        return self.member_idx.shape[1]
+
+
+def _edge_pairs(k: int):
+    return list(itertools.combinations(range(k), 2))
+
+
+def enumerate_cliques(
+    xy: jax.Array,
+    conf: jax.Array,
+    mask: jax.Array,
+    box_size,
+    *,
+    threshold: float = DEFAULT_THRESHOLD,
+    max_neighbors: int = 16,
+) -> CliqueSet:
+    """Enumerate all k-cliques of the k-partite overlap graph.
+
+    Args:
+        xy:   ``(K, N, 2)`` padded per-picker box corner coordinates.
+        conf: ``(K, N)`` padded per-picker confidences (probabilities).
+        mask: ``(K, N)`` bool validity of each padded slot.
+        box_size: scalar box edge length.
+        threshold: IoU edge threshold (reference uses 0.3).
+        max_neighbors: static per-pair neighbor capacity D.
+
+    Returns:
+        A :class:`CliqueSet` with capacity ``N * D**(K-1)``.
+    """
+    K, N, _ = xy.shape
+    D = min(max_neighbors, N)
+    dtype = xy.dtype
+
+    # Pairwise masked IoU matrices for every picker pair (static K).
+    iou = {}
+    for p, q in _edge_pairs(K):
+        iou[(p, q)] = pairwise_iou_matrix(
+            xy[p], mask[p], xy[q], mask[q], box_size
+        )
+
+    # Overflow probe: the enumeration is complete iff every anchor's
+    # above-threshold neighbor count fits in D for every pair (0, p).
+    adj_counts = [
+        jnp.sum(iou[(0, p)] > threshold, axis=1) for p in range(1, K)
+    ]
+    max_adjacency = jnp.max(jnp.stack(adj_counts)).astype(jnp.int32)
+
+    # Top-D neighbor lists of each anchor particle in every other picker.
+    nbr_idx, nbr_iou = [], []
+    for p in range(1, K):
+        v, i = jax.lax.top_k(iou[(0, p)], D)  # (N, D)
+        nbr_iou.append(v)
+        nbr_idx.append(i)
+
+    # Cartesian product over the K-1 neighbor slots.
+    grids = jnp.meshgrid(*([jnp.arange(D)] * (K - 1)), indexing="ij")
+    sel = [g.reshape(-1) for g in grids]          # each (Dprod,)
+    dprod = D ** (K - 1)
+
+    # Member particle indices per slot: anchor + K-1 neighbors.
+    anchor = jnp.broadcast_to(jnp.arange(N)[:, None], (N, dprod))
+    members = [anchor] + [nbr_idx[s][:, sel[s]] for s in range(K - 1)]
+
+    # Edge IoUs for every pair of the clique, in combinations order.
+    edge_vals = []
+    for p, q in _edge_pairs(K):
+        if p == 0:
+            edge_vals.append(nbr_iou[q - 1][:, sel[q - 1]])
+        else:
+            edge_vals.append(iou[(p, q)][members[p], members[q]])
+    edges = jnp.stack(edge_vals)                  # (E, N, Dprod)
+
+    valid = mask[0][:, None] & jnp.all(edges > threshold, axis=0)
+
+    # Member confidences, clique confidence, ILP weight.
+    confs = jnp.stack(
+        [jnp.broadcast_to(conf[0][:, None], (N, dprod))]
+        + [conf[p + 1][members[p + 1]] for p in range(K - 1)]
+    )                                             # (K, N, Dprod)
+    confidence = jnp.median(confs, axis=0)
+    edge_med = jnp.median(edges, axis=0)
+    w = jnp.where(valid, confidence * edge_med, 0.0).astype(dtype)
+    confidence = jnp.where(valid, confidence, 0.0).astype(dtype)
+
+    # Representative: member with max intra-clique weighted degree.
+    degs = []
+    for k_slot in range(K):
+        incident = [
+            edges[e]
+            for e, (p, q) in enumerate(_edge_pairs(K))
+            if p == k_slot or q == k_slot
+        ]
+        degs.append(sum(incident))
+    deg = jnp.stack(degs)                         # (K, N, Dprod)
+    rep_slot = jnp.argmax(deg, axis=0).astype(jnp.int32)  # (N, Dprod)
+
+    member_idx = jnp.stack(members, axis=-1)      # (N, Dprod, K)
+    rep_particle = jnp.take_along_axis(
+        member_idx, rep_slot[..., None], axis=-1
+    ).squeeze(-1)                                 # (N, Dprod)
+    rep_xy = xy[rep_slot, rep_particle]           # (N, Dprod, 2)
+
+    c = N * dprod
+    return CliqueSet(
+        member_idx=member_idx.reshape(c, K).astype(jnp.int32),
+        valid=valid.reshape(c),
+        w=w.reshape(c),
+        confidence=confidence.reshape(c),
+        rep_slot=rep_slot.reshape(c),
+        rep_xy=rep_xy.reshape(c, 2),
+        max_adjacency=max_adjacency,
+    )
+
+
+def compact_cliques(cs: CliqueSet, capacity: int) -> CliqueSet:
+    """Keep the ``capacity`` highest-weight cliques (static shape).
+
+    Invalid cliques sort to the bottom; if there are more than
+    ``capacity`` valid cliques the weakest are dropped (callers can
+    detect this via ``jnp.sum(cs.valid) > capacity``).
+    """
+    key = jnp.where(cs.valid, cs.w, -1.0)
+    _, order = jax.lax.top_k(key, min(capacity, cs.w.shape[0]))
+    return CliqueSet(
+        member_idx=cs.member_idx[order],
+        valid=cs.valid[order],
+        w=cs.w[order],
+        confidence=cs.confidence[order],
+        rep_slot=cs.rep_slot[order],
+        rep_xy=cs.rep_xy[order],
+        max_adjacency=cs.max_adjacency,
+    )

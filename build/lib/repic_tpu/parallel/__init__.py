@@ -1,0 +1,10 @@
+from repic_tpu.parallel.batching import PaddedBatch, pad_batch, bucket_size
+from repic_tpu.parallel.mesh import consensus_mesh, shard_over_micrographs
+
+__all__ = [
+    "PaddedBatch",
+    "pad_batch",
+    "bucket_size",
+    "consensus_mesh",
+    "shard_over_micrographs",
+]

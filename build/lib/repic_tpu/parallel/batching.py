@@ -1,0 +1,88 @@
+"""Ragged -> padded batch packing for the micrograph axis.
+
+The reference processes micrographs one at a time in a Python loop
+(reference: repic/commands/get_cliques.py:108) with ragged per-picker
+particle lists.  The TPU program instead wants one fixed-shape batch:
+
+    xy   (M, K, N, 2)   conf (M, K, N)   mask (M, K, N)
+
+where M is padded to a multiple of the device-mesh size and N is
+bucketed (next power of two) so recompiles are rare across datasets.
+Padded micrographs (mask all-False) flow through the whole pipeline and
+produce zero cliques; padded particle slots are masked out of the IoU
+kernel.
+"""
+
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+from repic_tpu.utils.box_io import BoxSet
+
+
+def bucket_size(n: int, minimum: int = 64) -> int:
+    """Next power of two >= n (>= minimum) — recompile-stable padding."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+class PaddedBatch(NamedTuple):
+    xy: np.ndarray        # (M, K, N, 2) float32
+    conf: np.ndarray      # (M, K, N) float32
+    mask: np.ndarray      # (M, K, N) bool
+    names: tuple          # (M,) micrograph basenames ('' = padding)
+    counts: np.ndarray    # (M, K) int32 true particle counts
+
+    @property
+    def num_micrographs(self) -> int:
+        return sum(1 for n in self.names if n)
+
+    @property
+    def num_pickers(self) -> int:
+        return self.xy.shape[1]
+
+    @property
+    def capacity(self) -> int:
+        return self.xy.shape[2]
+
+
+def pad_batch(
+    micrographs: Sequence[tuple[str, Sequence[BoxSet]]],
+    *,
+    pad_micrographs_to: int = 1,
+    capacity: int | None = None,
+) -> PaddedBatch:
+    """Pack per-micrograph, per-picker ragged BoxSets into one batch.
+
+    Args:
+        micrographs: list of (name, [BoxSet per picker]).
+        pad_micrographs_to: round M up to a multiple of this (the mesh
+            data-axis size), adding all-masked padding micrographs.
+        capacity: static N; default = bucket_size(max particle count).
+    """
+    k = len(micrographs[0][1])
+    max_n = max(
+        (bs.n for _, sets in micrographs for bs in sets), default=1
+    )
+    n = capacity or bucket_size(max_n)
+    if n < max_n:
+        raise ValueError(f"capacity {n} < max particle count {max_n}")
+    m_real = len(micrographs)
+    m = -(-m_real // pad_micrographs_to) * pad_micrographs_to
+
+    xy = np.zeros((m, k, n, 2), np.float32)
+    conf = np.zeros((m, k, n), np.float32)
+    mask = np.zeros((m, k, n), bool)
+    counts = np.zeros((m, k), np.int32)
+    names = []
+    for i, (name, sets) in enumerate(micrographs):
+        names.append(name)
+        for p, bs in enumerate(sets):
+            xy[i, p, : bs.n] = bs.xy
+            conf[i, p, : bs.n] = bs.conf
+            mask[i, p, : bs.n] = True
+            counts[i, p] = bs.n
+    names.extend([""] * (m - m_real))
+    return PaddedBatch(xy, conf, mask, tuple(names), counts)

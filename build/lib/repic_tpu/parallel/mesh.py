@@ -1,0 +1,35 @@
+"""Device-mesh helpers for sharding over the micrograph axis.
+
+The reference has no parallelism at all — micrographs are processed in
+a sequential loop (reference: repic/commands/get_cliques.py:108) and
+the only "communication backend" is files on disk (SURVEY.md §2c).
+Here the micrograph axis is the data-parallel axis of a 1-D
+``jax.sharding.Mesh``; per-micrograph problems are independent so the
+only collective is the implicit output gather XLA inserts.  On a
+multi-host pod the same code path shards over ICI+DCN via the global
+mesh — no explicit backend needed.
+"""
+
+from functools import partial
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MICROGRAPH_AXIS = "micrographs"
+
+
+def consensus_mesh(devices=None) -> Mesh:
+    """1-D mesh over all (or given) devices, micrograph-sharded."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devices.reshape(-1), (MICROGRAPH_AXIS,))
+
+
+def shard_over_micrographs(mesh: Mesh, *arrays):
+    """Place batch-leading arrays shard-wise over the mesh."""
+    sharding = NamedSharding(mesh, P(MICROGRAPH_AXIS))
+    return tuple(jax.device_put(a, sharding) for a in arrays)
+
+
+def micrograph_pspec() -> P:
+    return P(MICROGRAPH_AXIS)

@@ -1,0 +1,13 @@
+from repic_tpu.pipeline.consensus import (
+    ConsensusResult,
+    consensus_one,
+    make_batched_consensus,
+    run_consensus_dir,
+)
+
+__all__ = [
+    "ConsensusResult",
+    "consensus_one",
+    "make_batched_consensus",
+    "run_consensus_dir",
+]

@@ -1,0 +1,163 @@
+"""BOX coordinate-file I/O.
+
+Reproduces the parsing quirks of the reference BOX reader
+(reference: repic/utils/common.py:71-114):
+
+* optional single header line, sniffed by "is the first token a
+  float?" (common.py:79-80);
+* 5-column format ``x y w h conf`` (EMAN2 BOX with a confidence
+  column); 4-column files are accepted with confidence defaulting
+  to 1.0 (a superset of the reference, which requires 5 columns);
+* negative confidences are log-likelihoods and are sigmoid-mapped to
+  probabilities when any weight is negative (common.py:92-94);
+* and the output format of the consensus writer
+  (reference: repic/commands/run_ilp.py:120-129):
+  ``int(rint(x)) TAB int(rint(y)) TAB box TAB box TAB weight``,
+  sorted by weight descending.
+
+Unlike the reference there is no global mutable ``box_id`` counter
+(common.py:23) — particle identity is positional (picker slot,
+line index), which is deterministic under sharding.
+"""
+
+import os
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+
+class BoxSet(NamedTuple):
+    """Particles of one picker on one micrograph (host-side, ragged)."""
+
+    xy: np.ndarray     # (n, 2) float32 — lower-left corner
+    conf: np.ndarray   # (n,) float32 — probability-scale confidence
+    wh: np.ndarray     # (n, 2) float32 — box width/height as read
+
+    @property
+    def n(self) -> int:
+        return self.xy.shape[0]
+
+
+def _is_float(tok: str) -> bool:
+    try:
+        float(tok)
+    except ValueError:
+        return False
+    return True
+
+
+def read_box(path: str) -> BoxSet:
+    """Parse a BOX file; empty files yield an empty :class:`BoxSet`."""
+    xs, ys, ws, hs, cs = [], [], [], [], []
+    with open(path, "rt") as f:
+        first = True
+        for line in f:
+            toks = line.strip().split()
+            if not toks:
+                continue
+            if first and not _is_float(toks[0]):
+                first = False
+                continue  # header line
+            first = False
+            xs.append(float(toks[0]))
+            ys.append(float(toks[1]))
+            ws.append(float(toks[2]) if len(toks) > 2 else 0.0)
+            hs.append(float(toks[3]) if len(toks) > 3 else 0.0)
+            cs.append(float(toks[4]) if len(toks) > 4 else 1.0)
+    conf = np.asarray(cs, dtype=np.float32)
+    if conf.size and conf.min() < 0:
+        # log-likelihood scores -> probabilities (common.py:92-94)
+        conf = 1.0 / (1.0 + np.exp(-conf))
+    return BoxSet(
+        xy=np.stack([xs, ys], axis=-1).astype(np.float32)
+        if xs
+        else np.zeros((0, 2), np.float32),
+        conf=conf,
+        wh=np.stack([ws, hs], axis=-1).astype(np.float32)
+        if ws
+        else np.zeros((0, 2), np.float32),
+    )
+
+
+def write_box(
+    path: str,
+    xy: np.ndarray,
+    weights: np.ndarray,
+    box_size: int,
+    *,
+    num_particles: int | None = None,
+    sort: bool = True,
+) -> None:
+    """Write a consensus BOX file in the reference's output format."""
+    xy = np.asarray(xy)
+    weights = np.asarray(weights)
+    order = np.argsort(-weights, kind="stable") if sort else np.arange(len(weights))
+    if num_particles is not None:
+        order = order[:num_particles]
+    bs = str(int(box_size))
+    with open(path, "wt") as o:
+        for i in order:
+            o.write(
+                "\t".join(
+                    [
+                        str(int(np.rint(xy[i, 0]))),
+                        str(int(np.rint(xy[i, 1]))),
+                        bs,
+                        bs,
+                        str(weights[i]),
+                    ]
+                )
+                + "\n"
+            )
+
+
+def write_empty_box(path: str) -> None:
+    """Empty placeholder BOX file (reference: get_cliques.py:124-130)."""
+    with open(path, "wt"):
+        pass
+
+
+def discover_picker_dirs(in_dir: str) -> list[str]:
+    """Sorted picker subdirectory names (reference: get_cliques.py:81-82)."""
+    return sorted(
+        d
+        for d in os.listdir(in_dir)
+        if os.path.isdir(os.path.join(in_dir, d))
+    )
+
+
+def micrograph_names(picker_dir: str) -> list[str]:
+    """Sorted micrograph basenames from a picker's BOX files."""
+    return sorted(
+        f[: -len(".box")]
+        for f in os.listdir(picker_dir)
+        if f.endswith(".box")
+    )
+
+
+def load_micrograph_set(
+    in_dir: str, pickers: Sequence[str], name: str
+) -> list[BoxSet] | None:
+    """Load one micrograph's BOX file from every picker.
+
+    Returns None if any picker is missing the micrograph or picked no
+    particles (the reference then emits an empty consensus file and
+    skips — get_cliques.py:123-130).
+    """
+    sets = []
+    for p in pickers:
+        path = os.path.join(in_dir, p, name + ".box")
+        if not os.path.isfile(path):
+            matches = [
+                f
+                for f in os.listdir(os.path.join(in_dir, p))
+                if f.endswith(".box") and name in f
+            ]
+            if len(matches) != 1:
+                return None
+            path = os.path.join(in_dir, p, matches[0])
+        bs = read_box(path)
+        if bs.n == 0:
+            return None
+        sets.append(bs)
+    return sets

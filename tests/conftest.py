@@ -1,0 +1,43 @@
+"""Test harness: force a virtual 8-device CPU mesh before jax import.
+
+Sharding is tested without TPU hardware by asking XLA for 8 host
+platform devices (SURVEY.md §4: multi-device tests via CPU-mesh
+simulation).  This must run before anything imports jax.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The sandbox's sitecustomize may import jax (registering a TPU
+# plugin) before this conftest runs, in which case the env var alone
+# is too late — force the platform via the config API as well.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)
+
+
+REFERENCE_EXAMPLES = "/root/reference/examples/10017"
+
+
+def reference_available() -> bool:
+    return os.path.isdir(REFERENCE_EXAMPLES)
+
+
+needs_reference = pytest.mark.skipif(
+    not reference_available(),
+    reason="reference example data not mounted",
+)

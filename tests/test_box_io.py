@@ -1,0 +1,82 @@
+"""BOX I/O tests: header sniffing, sigmoid conversion, output format."""
+
+import numpy as np
+
+from repic_tpu.utils import box_io
+
+
+def test_read_plain(tmp_path):
+    p = tmp_path / "a.box"
+    p.write_text("10\t20\t180\t180\t0.5\n30\t40\t180\t180\t0.9\n")
+    bs = box_io.read_box(str(p))
+    assert bs.n == 2
+    np.testing.assert_allclose(bs.xy, [[10, 20], [30, 40]])
+    np.testing.assert_allclose(bs.conf, [0.5, 0.9])
+
+
+def test_read_header_skipped(tmp_path):
+    p = tmp_path / "a.box"
+    p.write_text("x y w h conf\n10 20 180 180 0.5\n")
+    bs = box_io.read_box(str(p))
+    assert bs.n == 1
+
+
+def test_sigmoid_for_log_likelihoods(tmp_path):
+    # topaz confidences are log-likelihoods; any negative value
+    # triggers sigmoid conversion of ALL weights (common.py:92-94)
+    p = tmp_path / "a.box"
+    p.write_text("10 20 180 180 -1.0\n30 40 180 180 2.0\n")
+    bs = box_io.read_box(str(p))
+    np.testing.assert_allclose(
+        bs.conf, [1 / (1 + np.e), 1 / (1 + np.exp(-2.0))], rtol=1e-6
+    )
+
+
+def test_positive_weights_not_converted(tmp_path):
+    p = tmp_path / "a.box"
+    p.write_text("10 20 180 180 3.7\n")
+    bs = box_io.read_box(str(p))
+    np.testing.assert_allclose(bs.conf, [3.7])
+
+
+def test_empty_file(tmp_path):
+    p = tmp_path / "a.box"
+    p.write_text("")
+    assert box_io.read_box(str(p)).n == 0
+
+
+def test_four_column_defaults_conf(tmp_path):
+    p = tmp_path / "a.box"
+    p.write_text("10 20 180 180\n")
+    bs = box_io.read_box(str(p))
+    np.testing.assert_allclose(bs.conf, [1.0])
+
+
+def test_write_box_format(tmp_path):
+    p = tmp_path / "out.box"
+    xy = np.array([[10.4, 20.6], [30.0, 40.0]])
+    w = np.array([0.25, 0.75], np.float32)
+    box_io.write_box(str(p), xy, w, 180)
+    lines = p.read_text().splitlines()
+    # sorted by weight descending; x/y rounded to int
+    assert lines[0].split("\t")[:4] == ["30", "40", "180", "180"]
+    assert lines[1].split("\t")[:4] == ["10", "21", "180", "180"]
+    assert float(lines[0].split("\t")[4]) == 0.75
+
+
+def test_write_box_num_particles_cutoff(tmp_path):
+    p = tmp_path / "out.box"
+    xy = np.zeros((5, 2))
+    w = np.arange(5, dtype=np.float32)
+    box_io.write_box(str(p), xy, w, 100, num_particles=2)
+    assert len(p.read_text().splitlines()) == 2
+
+
+def test_roundtrip(tmp_path):
+    p = tmp_path / "r.box"
+    xy = np.array([[1.0, 2.0], [3.0, 4.0]])
+    w = np.array([0.9, 0.1], np.float32)
+    box_io.write_box(str(p), xy, w, 64)
+    bs = box_io.read_box(str(p))
+    assert bs.n == 2
+    np.testing.assert_allclose(sorted(bs.conf), [0.1, 0.9], rtol=1e-6)

@@ -1,0 +1,146 @@
+"""CLI tests: two-phase get_cliques/run_ilp path, fused consensus
+path, and their agreement."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from repic_tpu.main import main as cli_main
+from tests.conftest import REFERENCE_EXAMPLES, needs_reference
+
+
+def _write_picker_dirs(tmp_path, rng, n_micro=3, k=3, n_per=25):
+    from tests.test_cliques import random_sets
+
+    in_dir = tmp_path / "in"
+    names = [f"mic_{i}" for i in range(n_micro)]
+    for name in names:
+        sets = random_sets(rng, k, n_per, spread=900.0)
+        for p, s in enumerate(sets):
+            d = in_dir / f"picker{p}"
+            d.mkdir(parents=True, exist_ok=True)
+            with open(d / f"{name}.box", "wt") as f:
+                for x, y, c in s:
+                    f.write(f"{x}\t{y}\t180\t180\t{c}\n")
+    return in_dir, names
+
+
+def test_version(capsys):
+    with pytest.raises(SystemExit):
+        cli_main(["--version"])
+    assert "repic-tpu" in capsys.readouterr().out
+
+
+def test_two_phase_pipeline(tmp_path, rng):
+    in_dir, names = _write_picker_dirs(tmp_path, rng)
+    out_dir = tmp_path / "cliques"
+    cli_main(["get_cliques", str(in_dir), str(out_dir), "180", "--no_mesh"])
+    for name in names:
+        for label in (
+            "weight_vector",
+            "consensus_coords",
+            "consensus_confidences",
+            "constraint_matrix",
+        ):
+            assert (out_dir / f"{name}_{label}.pickle").exists()
+        assert (out_dir / f"{name}_runtime.tsv").exists()
+
+    cli_main(["run_ilp", str(out_dir), "180"])
+    for name in names:
+        box = out_dir / f"{name}.box"
+        assert box.exists()
+        rt = (out_dir / f"{name}_runtime.tsv").read_text().splitlines()
+        assert len(rt) == 2  # get_cliques stats + run_ilp runtime
+
+
+def test_constraint_matrix_structure(tmp_path, rng):
+    in_dir, names = _write_picker_dirs(tmp_path, rng, n_micro=1)
+    out_dir = tmp_path / "cliques"
+    cli_main(["get_cliques", str(in_dir), str(out_dir), "180", "--no_mesh"])
+    with open(out_dir / f"{names[0]}_constraint_matrix.pickle", "rb") as f:
+        a_mat = pickle.load(f)
+    with open(out_dir / f"{names[0]}_weight_vector.pickle", "rb") as f:
+        w = pickle.load(f)
+    assert a_mat.shape[1] == len(w)
+    # every clique has exactly k members
+    counts = np.diff(a_mat.tocsc().indptr)
+    assert (counts == 3).all()
+
+
+def test_multi_out_tsv(tmp_path, rng):
+    in_dir, names = _write_picker_dirs(tmp_path, rng, n_micro=1)
+    out_dir = tmp_path / "cliques"
+    cli_main(
+        [
+            "get_cliques",
+            str(in_dir),
+            str(out_dir),
+            "180",
+            "--multi_out",
+            "--no_mesh",
+        ]
+    )
+    cli_main(["run_ilp", str(out_dir), "180"])
+    tsv = out_dir / f"{names[0]}.tsv"
+    assert tsv.exists()
+    lines = tsv.read_text().splitlines()
+    assert lines[0].split("\t") == ["picker0", "picker1", "picker2"]
+    # rows: 2 cols per picker + weight
+    assert all(len(l.split("\t")) == 7 for l in lines[1:])
+    # singleton rows have N/A pairs and weight 0
+    singles = [l for l in lines[1:] if "N/A" in l]
+    assert singles, "expected conf-0 singleton rows"
+    assert all(float(l.split("\t")[-1]) == 0.0 for l in singles)
+
+
+def test_exact_and_greedy_backends_agree_on_objective(tmp_path, rng):
+    in_dir, names = _write_picker_dirs(tmp_path, rng, n_micro=2)
+    out_dir = tmp_path / "cliques"
+    cli_main(["get_cliques", str(in_dir), str(out_dir), "180", "--no_mesh"])
+    import shutil
+
+    out2 = tmp_path / "cliques2"
+    shutil.copytree(out_dir, out2)
+    cli_main(["run_ilp", str(out_dir), "180", "--backend", "exact"])
+    cli_main(["run_ilp", str(out2), "180", "--backend", "greedy"])
+    for name in names:
+        exact = (out_dir / f"{name}.box").read_text().splitlines()
+        greedy = (out2 / f"{name}.box").read_text().splitlines()
+        # greedy is near-optimal; particle sets overlap heavily
+        se = {l.split("\t")[0:2] and tuple(l.split("\t")[:2]) for l in exact}
+        sg = {tuple(l.split("\t")[:2]) for l in greedy}
+        jac = len(se & sg) / max(len(se | sg), 1)
+        assert jac >= 0.9
+
+
+@needs_reference
+def test_fused_matches_two_phase_greedy(tmp_path):
+    out_fused = tmp_path / "fused"
+    out_two = tmp_path / "two"
+    cli_main(
+        [
+            "consensus",
+            REFERENCE_EXAMPLES,
+            str(out_fused),
+            "180",
+            "--no_mesh",
+        ]
+    )
+    cli_main(
+        ["get_cliques", REFERENCE_EXAMPLES, str(out_two), "180", "--no_mesh"]
+    )
+    cli_main(["run_ilp", str(out_two), "180", "--backend", "greedy"])
+    names = [f[:-4] for f in os.listdir(out_fused) if f.endswith(".box")]
+    assert len(names) == 12
+    for name in names:
+        fused = {
+            tuple(l.split("\t")[:2])
+            for l in (out_fused / f"{name}.box").read_text().splitlines()
+        }
+        two = {
+            tuple(l.split("\t")[:2])
+            for l in (out_two / f"{name}.box").read_text().splitlines()
+        }
+        assert fused == two

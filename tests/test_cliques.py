@@ -1,0 +1,119 @@
+"""k-partite clique enumeration vs a brute-force oracle."""
+
+import itertools
+
+import numpy as np
+import jax.numpy as jnp
+
+from repic_tpu.ops.cliques import enumerate_cliques
+from tests.test_iou import ref_jaccard
+
+
+def brute_force_cliques(sets, box, threshold=0.3):
+    """All k-tuples (one particle per picker) with all pairwise IoU > t."""
+    k = len(sets)
+    out = []
+    for combo in itertools.product(*[range(len(s)) for s in sets]):
+        ok = True
+        edge_ious = []
+        for p, q in itertools.combinations(range(k), 2):
+            xi, yi = sets[p][combo[p]][:2]
+            xj, yj = sets[q][combo[q]][:2]
+            ji = ref_jaccard(xi, yi, xj, yj, box)
+            edge_ious.append(ji)
+            if ji <= threshold:
+                ok = False
+                break
+        if ok:
+            confs = [sets[p][combo[p]][2] for p in range(k)]
+            w = float(np.median(confs) * np.median(edge_ious))
+            out.append((combo, w))
+    return dict(out)
+
+
+def make_padded(sets, n):
+    k = len(sets)
+    xy = np.zeros((k, n, 2), np.float32)
+    conf = np.zeros((k, n), np.float32)
+    mask = np.zeros((k, n), bool)
+    for p, s in enumerate(sets):
+        for i, (x, y, c) in enumerate(s):
+            xy[p, i] = (x, y)
+            conf[p, i] = c
+            mask[p, i] = True
+    return jnp.asarray(xy), jnp.asarray(conf), jnp.asarray(mask)
+
+
+def random_sets(rng, k, n_per, spread=1500.0):
+    return [
+        [
+            (
+                float(rng.uniform(0, spread)),
+                float(rng.uniform(0, spread)),
+                float(rng.uniform(0.1, 1.0)),
+            )
+            for _ in range(n_per)
+        ]
+        for _ in range(k)
+    ]
+
+
+def _check(sets, box, n_pad, max_neighbors=16):
+    xy, conf, mask = make_padded(sets, n_pad)
+    cs = enumerate_cliques(xy, conf, mask, box, max_neighbors=max_neighbors)
+    valid = np.asarray(cs.valid)
+    mem = np.asarray(cs.member_idx)[valid]
+    w = np.asarray(cs.w)[valid]
+    mine = {tuple(row): wv for row, wv in zip(mem, w)}
+    want = brute_force_cliques(sets, box)
+    assert set(mine) == set(want)
+    for key in want:
+        np.testing.assert_allclose(mine[key], want[key], rtol=1e-5)
+    return cs
+
+
+def test_k3_random(rng):
+    sets = random_sets(rng, 3, 40)
+    _check(sets, 180.0, 64)
+
+
+def test_k4_random(rng):
+    sets = random_sets(rng, 4, 25, spread=800.0)
+    _check(sets, 180.0, 32)
+
+
+def test_k5_random(rng):
+    sets = random_sets(rng, 5, 12, spread=500.0)
+    _check(sets, 180.0, 16, max_neighbors=8)
+
+
+def test_k2_pairs(rng):
+    sets = random_sets(rng, 2, 50)
+    _check(sets, 180.0, 64)
+
+
+def test_dense_cluster_overflow_probe():
+    # 20 near-identical boxes per picker: adjacency exceeds D=4
+    base = [(100.0 + i, 100.0 + i, 0.5) for i in range(20)]
+    sets = [base, base, base]
+    xy, conf, mask = make_padded(sets, 32)
+    cs = enumerate_cliques(xy, conf, mask, 180.0, max_neighbors=4)
+    assert int(cs.max_adjacency) > 4  # overflow is detected
+
+
+def test_representative_max_weighted_degree():
+    # anchor overlaps both others strongly; picker1's is the hub
+    sets = [
+        [(0.0, 0.0, 0.9)],
+        [(10.0, 0.0, 0.8)],
+        [(20.0, 0.0, 0.7)],
+    ]
+    xy, conf, mask = make_padded(sets, 8)
+    cs = enumerate_cliques(xy, conf, mask, 180.0)
+    valid = np.asarray(cs.valid)
+    assert valid.sum() == 1
+    # middle box (picker 1) has max summed IoU to the others
+    assert int(np.asarray(cs.rep_slot)[valid][0]) == 1
+    np.testing.assert_allclose(
+        np.asarray(cs.rep_xy)[valid][0], [10.0, 0.0]
+    )

@@ -1,0 +1,63 @@
+"""Unit tests for the pairwise IoU kernel vs closed-form cases."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from repic_tpu.ops.iou import pair_iou, pairwise_iou_matrix
+
+
+def ref_jaccard(x, y, a, b, box):
+    """Closed-form oracle: IoU of equal-size axis-aligned boxes."""
+    xo = max(min(x, a) + box - max(x, a), 0)
+    yo = max(min(y, b) + box - max(y, b), 0)
+    inter = xo * yo
+    return inter / (2 * box * box - inter)
+
+
+def test_identical_boxes():
+    xy = jnp.array([[10.0, 20.0]])
+    assert np.allclose(pair_iou(xy, xy, 100.0), 1.0)
+
+
+def test_disjoint_boxes():
+    a = jnp.array([[0.0, 0.0]])
+    b = jnp.array([[500.0, 0.0]])
+    assert np.allclose(pair_iou(a, b, 100.0), 0.0)
+
+
+def test_half_shift():
+    # shift by half the box in x: inter = b/2 * b, union = 2b^2 - inter
+    a = jnp.array([[0.0, 0.0]])
+    b = jnp.array([[50.0, 0.0]])
+    expect = (50 * 100) / (2 * 100 * 100 - 50 * 100)
+    assert np.allclose(pair_iou(a, b, 100.0), expect)
+
+
+def test_touching_edges_zero():
+    a = jnp.array([[0.0, 0.0]])
+    b = jnp.array([[100.0, 0.0]])
+    assert np.allclose(pair_iou(a, b, 100.0), 0.0)
+
+
+def test_matches_oracle_random(rng):
+    box = 180.0
+    a = rng.uniform(0, 4000, size=(60, 2)).astype(np.float32)
+    b = rng.uniform(0, 4000, size=(70, 2)).astype(np.float32)
+    got = np.asarray(pair_iou(jnp.asarray(a), jnp.asarray(b), box))
+    want = np.array(
+        [[ref_jaccard(x, y, p, q, box) for (p, q) in b] for (x, y) in a]
+    )
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_masked_entries_zero(rng):
+    a = rng.uniform(0, 400, size=(8, 2)).astype(np.float32)
+    mask_a = np.array([True] * 4 + [False] * 4)
+    m = np.asarray(
+        pairwise_iou_matrix(
+            jnp.asarray(a), jnp.asarray(mask_a), jnp.asarray(a),
+            jnp.asarray(mask_a), 180.0,
+        )
+    )
+    assert np.all(m[4:] == 0) and np.all(m[:, 4:] == 0)
+    np.testing.assert_allclose(np.diag(m[:4, :4]), 1.0, rtol=1e-5)

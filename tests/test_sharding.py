@@ -1,0 +1,90 @@
+"""Multi-device sharding tests on the virtual 8-device CPU mesh."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from repic_tpu.parallel.batching import pad_batch, bucket_size
+from repic_tpu.parallel.mesh import consensus_mesh, MICROGRAPH_AXIS
+from repic_tpu.pipeline.consensus import (
+    consensus_one,
+    run_consensus_batch,
+)
+from repic_tpu.utils.box_io import BoxSet
+from tests.test_cliques import random_sets
+
+
+def test_eight_devices_available():
+    assert len(jax.devices()) == 8
+
+
+def _to_boxsets(sets):
+    return [
+        BoxSet(
+            xy=np.array([(x, y) for x, y, _ in s], np.float32),
+            conf=np.array([c for _, _, c in s], np.float32),
+            wh=np.zeros((len(s), 2), np.float32),
+        )
+        for s in sets
+    ]
+
+
+def test_bucket_size():
+    assert bucket_size(1) == 64
+    assert bucket_size(65) == 128
+    assert bucket_size(800) == 1024
+
+
+def test_batch_padding_to_mesh(rng):
+    micros = [
+        (f"m{i}", _to_boxsets(random_sets(rng, 3, 20 + i)))
+        for i in range(5)
+    ]
+    batch = pad_batch(micros, pad_micrographs_to=8)
+    assert batch.xy.shape[0] == 8
+    assert batch.num_micrographs == 5
+    assert not batch.mask[5:].any()
+
+
+def test_sharded_equals_single_device(rng):
+    micros = [
+        (f"m{i}", _to_boxsets(random_sets(rng, 3, 30)))
+        for i in range(8)
+    ]
+    batch = pad_batch(micros, pad_micrographs_to=8)
+    res_mesh = run_consensus_batch(batch, 180.0, use_mesh=True)
+    res_single = run_consensus_batch(batch, 180.0, use_mesh=False)
+    np.testing.assert_array_equal(
+        np.asarray(res_mesh.picked), np.asarray(res_single.picked)
+    )
+    np.testing.assert_allclose(
+        np.asarray(res_mesh.w), np.asarray(res_single.w), rtol=1e-6
+    )
+
+
+def test_padded_micrographs_produce_no_cliques(rng):
+    micros = [("m0", _to_boxsets(random_sets(rng, 3, 30)))]
+    batch = pad_batch(micros, pad_micrographs_to=8)
+    res = run_consensus_batch(batch, 180.0, use_mesh=True)
+    num = np.asarray(res.num_cliques)
+    assert (num[1:] == 0).all()
+    assert not np.asarray(res.picked)[1:].any()
+
+
+def test_output_sharding_layout(rng):
+    micros = [
+        (f"m{i}", _to_boxsets(random_sets(rng, 3, 16)))
+        for i in range(8)
+    ]
+    batch = pad_batch(micros, pad_micrographs_to=8)
+    mesh = consensus_mesh()
+    from repic_tpu.pipeline.consensus import make_batched_consensus
+    from repic_tpu.parallel.mesh import shard_over_micrographs
+
+    fn = make_batched_consensus(mesh=mesh)
+    xy, conf, mask = shard_over_micrographs(
+        mesh, batch.xy, batch.conf, batch.mask
+    )
+    res = fn(xy, conf, mask, 180.0)
+    spec = res.picked.sharding.spec
+    assert spec[0] == MICROGRAPH_AXIS
