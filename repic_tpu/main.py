@@ -19,6 +19,7 @@ COMMAND_MODULES = [
     "repic_tpu.commands.run_ilp",
     "repic_tpu.commands.consensus",
     "repic_tpu.commands.iter_config",
+    "repic_tpu.utils.coords",
 ]
 
 

@@ -38,10 +38,10 @@ class BoxSet(NamedTuple):
         return self.xy.shape[0]
 
 
-def _is_float(tok: str) -> bool:
+def _is_float(tok) -> bool:
     try:
         float(tok)
-    except ValueError:
+    except (TypeError, ValueError):
         return False
     return True
 

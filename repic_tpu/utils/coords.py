@@ -1,0 +1,541 @@
+"""Particle-coordinate format conversion (STAR / BOX / CBOX / TSV / CS).
+
+Host-side I/O glue with the same capability surface as the reference
+converter (reference: repic/utils/coord_converter.py:292-469): N-way
+conversion between RELION STAR, EMAN BOX, crYOLO CBOX, Topaz TSV and
+CryoSparc ``.cs`` files, with column remapping, center<->corner
+geometry shifts, rounding, confidence normalization / backfill, and
+single-file or per-micrograph-split output.
+
+Architecture differs from the reference's single 180-line handler:
+formats are entries in a registry (``FORMATS``) carrying a parser and
+a default column map (reference's header-map tables:
+coord_converter.py:23-48), and conversion is an explicit pipeline of
+small steps over a canonical DataFrame whose columns are a subset of
+``["x", "y", "w", "h", "conf", "name"]``.
+
+This module is deliberately NOT a jit surface — coordinates enter the
+TPU compute path only after batching/padding (parallel/batching.py).
+"""
+
+import os
+import re
+import sys
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Callable
+
+import numpy as np
+import pandas as pd
+
+from repic_tpu.utils.box_io import _is_float
+
+# Canonical column names, in canonical order.
+COLUMNS = ("x", "y", "w", "h", "conf", "name")
+
+# RELION STAR loop labels (reference: coord_converter.py:23-26).
+STAR_LABELS = {
+    "x": "_rlnCoordinateX",
+    "y": "_rlnCoordinateY",
+    "conf": "_rlnAutopickFigureOfMerit",
+    "name": "_rlnMicrographName",
+}
+
+AUTO = "auto"
+
+_log_quiet = False
+
+
+def _log(msg, lvl=0):
+    """Leveled logger: 0 info (suppressed by quiet), 1 warn, 2 fatal
+    (reference: coord_converter.py:56-76)."""
+    if lvl == 0 and _log_quiet:
+        return
+    print(("INFO: ", "WARN: ", "CRITICAL: ")[lvl] + str(msg))
+    if lvl == 2:
+        sys.exit(1)
+
+
+def _has_digit(s) -> bool:
+    return re.search("[0-9]", str(s)) is not None
+
+
+# --------------------------------------------------------------------
+# parsers — each returns a raw DataFrame; columns are either integer
+# positions (tsv-like formats) or STAR label strings
+# --------------------------------------------------------------------
+
+
+def read_tsv_like(path) -> pd.DataFrame:
+    """Whitespace-delimited table; leading non-numeric / ``_``-label
+    lines are skipped and trailing all-non-numeric rows (CBOX footers)
+    are dropped (reference: coord_converter.py:200-240)."""
+    skip = 0
+    with open(path, "rt") as f:
+        for i, line in enumerate(f):
+            if not line.startswith("_") and _has_digit(line):
+                skip = i
+                break
+    try:
+        df = pd.read_csv(
+            path, sep=r"\s+", header=None, skip_blank_lines=True,
+            skiprows=skip,
+        )
+    except pd.errors.EmptyDataError:
+        return pd.DataFrame()
+    nonnumeric = df.apply(
+        lambda row: all(not _is_float(v) for v in row.dropna()), axis=1
+    )
+    return df[~nonnumeric]
+
+
+def read_star(path) -> pd.DataFrame:
+    """RELION STAR table reader.
+
+    Parses ``_label #N`` loop headers into a {position: label} map,
+    skips ``data_optics`` blocks, then reads the whitespace table and
+    renames columns to their STAR labels
+    (reference: coord_converter.py:152-197).
+    """
+    header: dict[int, str] = {}
+    data_start = 0
+    with open(path, "rt") as f:
+        skipping_block = False
+        for i, line in enumerate(f):
+            ln = line.strip()
+            if not ln:
+                continue
+            if ln.startswith("data_"):
+                skipping_block = "data_optics" in ln
+                continue
+            if skipping_block:
+                continue
+            if ln.startswith("_") and ln.count("#") == 1:
+                label, _, pos = ln.partition("#")
+                try:
+                    header[int(pos) - 1] = label.strip()
+                except ValueError:
+                    _log("STAR file not properly formatted", lvl=2)
+                data_start = i + 1
+            elif header and _has_digit(ln):
+                data_start = i
+                break
+    try:
+        df = pd.read_csv(
+            path, sep=r"\s+", header=None, skip_blank_lines=True,
+            skiprows=data_start,
+        )
+        df = df.rename(columns={df.columns[k]: v for k, v in header.items()})
+    except pd.errors.EmptyDataError:
+        df = pd.DataFrame(columns=list(header.values()))
+    return df
+
+
+def read_cs(path) -> pd.DataFrame:
+    """CryoSparc ``.cs`` structured-array reader.
+
+    Fractional center coordinates are scaled to pixels by the stored
+    micrograph dims, and the box w/h come from the blob shape field
+    (reference: coord_converter.py:119-149).  Output columns are
+    already canonical.
+    """
+    try:
+        data = np.load(path, allow_pickle=True)
+    except ValueError:
+        _log(f"numpy could not load {path}", lvl=2)
+    if len(data) == 0:
+        _log(f"no data found in file at {path}", lvl=2)
+    rows = pd.DataFrame(data.tolist())
+    dims = rows[9]
+    out = pd.DataFrame(
+        {
+            "x": rows[10] * dims.apply(lambda d: d[1]),
+            "y": rows[11] * dims.apply(lambda d: d[0]),
+            "w": rows[3].apply(lambda s: s[1]),
+            "h": rows[3].apply(lambda s: s[0]),
+            "name": rows[8].apply(
+                lambda b: b.decode() if isinstance(b, bytes) else b
+            ),
+        }
+    )
+    return out
+
+
+@dataclass(frozen=True)
+class Format:
+    """A coordinate-file format: parser + default column mapping.
+
+    ``colmap`` maps canonical names to raw-column keys (int position
+    or STAR label); ``None`` = the format does not carry that column
+    (reference's header maps: coord_converter.py:28-48).
+
+    ``centered``: x/y are particle centers (vs. lower-left corner).
+    ``None`` means the format takes part in NO geometry shift — the
+    reference applies center->corner only to star/tsv/cs input and
+    corner->center only to box input (coord_converter.py:366,376), so
+    cbox is never shifted even though its coordinates are corners;
+    kept for output parity.
+    """
+
+    name: str
+    read: Callable[[str], pd.DataFrame]
+    colmap: dict
+    centered: bool | None
+
+
+FORMATS = {
+    "box": Format(
+        "box", read_tsv_like,
+        {"x": 0, "y": 1, "w": 2, "h": 3, "conf": 4, "name": None},
+        centered=False,
+    ),
+    "cbox": Format(
+        "cbox",
+        lambda p: read_tsv_like(p).apply(pd.to_numeric),
+        {"x": 0, "y": 1, "w": 3, "h": 4, "conf": 8, "name": None},
+        centered=None,
+    ),
+    "tsv": Format(
+        "tsv", read_tsv_like,
+        {"x": 0, "y": 1, "w": None, "h": None, "conf": 2, "name": None},
+        centered=True,
+    ),
+    "star": Format(
+        "star", read_star,
+        {
+            "x": STAR_LABELS["x"],
+            "y": STAR_LABELS["y"],
+            "w": None,
+            "h": None,
+            "conf": STAR_LABELS["conf"],
+            "name": STAR_LABELS["name"],
+        },
+        centered=True,
+    ),
+    "cs": Format(
+        "cs", read_cs,
+        {"x": "x", "y": "y", "w": "w", "h": "h", "conf": None,
+         "name": "name"},
+        centered=True,
+    ),
+}
+
+
+# --------------------------------------------------------------------
+# conversion pipeline steps
+# --------------------------------------------------------------------
+
+
+def _remap_columns(df, colmap) -> pd.DataFrame:
+    """Rename raw columns (int positions or label strings) to canonical
+    names (reference: coord_converter.py:350-362)."""
+    rename = {}
+    for canon, raw in colmap.items():
+        if raw is None:
+            continue
+        if isinstance(raw, str) and raw.lstrip("-").isdigit():
+            raw = int(raw)
+        if isinstance(raw, (int, np.integer)):
+            if 0 <= raw < len(df.columns):
+                rename[df.columns[raw]] = canon
+        elif raw in df.columns:
+            rename[raw] = canon
+    return df.rename(columns=rename)
+
+
+def _shift_geometry(df, in_fmt: Format, out_fmt: str, boxsize):
+    """Center<->corner conversion between centered and corner formats
+    (reference: coord_converter.py:366-380).
+
+    Centered input -> box output: set w=h=boxsize, x -= w/2, y -= h/2.
+    Corner (box) input -> centered output: x += w/2, y += h/2.
+    """
+    if in_fmt.centered is None:
+        return df  # cbox: no shift, matching the reference (see Format)
+    out_centered = out_fmt in ("star", "tsv")
+    if in_fmt.centered and not out_centered:
+        if boxsize is None:
+            raise ValueError("box size required for centered input")
+        df["w"] = boxsize
+        df["h"] = boxsize
+        for c in ("x", "y", "w", "h"):
+            df[c] = df[c].astype(float)
+        df["x"] -= df["w"] / 2
+        df["y"] -= df["h"] / 2
+    elif not in_fmt.centered and out_centered:
+        for c in ("x", "y", "w", "h"):
+            df[c] = df[c].astype(float)
+        df["x"] += df["w"] / 2
+        df["y"] += df["h"] / 2
+    return df
+
+
+def _round_coords(df, round_to):
+    """Round x/y/w/h; integer cast at round_to=0
+    (reference: coord_converter.py:382-388)."""
+    if round_to is None:
+        return df
+    for c in ("x", "y", "w", "h"):
+        if c in df.columns:
+            df[c] = df[c].round(round_to)
+            if round_to == 0:
+                df[c] = df[c].astype(int)
+    return df
+
+
+def _normalize_conf(df, norm_conf):
+    """Linearly rescale confidences into [new_min, new_max] when they
+    fall outside it (reference: coord_converter.py:398-410)."""
+    if norm_conf is None or "conf" not in df.columns:
+        return df
+    new_min, new_max = norm_conf
+    old_min, old_max = df["conf"].min(), df["conf"].max()
+    if old_min <= new_min or old_max > new_max:
+        old_range = old_max - old_min
+        if old_range == 0:
+            df["conf"] = new_min
+        else:
+            df["conf"] = (
+                (df["conf"] - old_min) * (new_max - new_min) / old_range
+                + new_min
+            )
+    return df
+
+
+# --------------------------------------------------------------------
+# writers
+# --------------------------------------------------------------------
+
+
+def write_star(df, out_path, force=False) -> None:
+    """STAR writer: ``data_/loop_`` header with 1-based column tags,
+    then tab-separated rows (reference: coord_converter.py:246-271)."""
+    _check_target(out_path, force)
+    cols = list(df.columns)
+    lines = "data_\n\nloop_\n"
+    for canon, label in STAR_LABELS.items():
+        if canon in cols:
+            lines += f"{label} #{cols.index(canon) + 1}\n"
+    with open(out_path, "wt") as f:
+        f.write(lines)
+    df.to_csv(out_path, header=False, sep="\t", index=False, mode="a")
+
+
+def write_tsv(df, col_order, out_path, include_header=False, force=False):
+    """BOX/TSV writer with caller-chosen column order
+    (reference: coord_converter.py:274-286)."""
+    _check_target(out_path, force)
+    out_cols = [c for c in col_order if c in df.columns]
+    df[out_cols].to_csv(out_path, header=include_header, sep="\t", index=False)
+
+
+def _check_target(out_path, force):
+    if force:
+        Path(out_path).parent.mkdir(parents=True, exist_ok=True)
+    elif Path(out_path).resolve().is_file():
+        _log("re-run with the force flag to replace existing files", lvl=2)
+
+
+# --------------------------------------------------------------------
+# top-level conversion
+# --------------------------------------------------------------------
+
+
+def convert(
+    paths,
+    in_fmt: str,
+    out_fmt: str,
+    *,
+    boxsize=None,
+    out_dir=None,
+    in_cols=None,
+    out_col_order=COLUMNS,
+    suffix="",
+    include_header=False,
+    single_out=False,
+    multi_out=False,
+    round_to=None,
+    norm_conf=None,
+    require_conf=None,
+    force=False,
+    quiet=False,
+):
+    """Convert coordinate files between formats.
+
+    Mirrors the reference handler's semantics end to end
+    (reference: coord_converter.py:292-469): parse -> column remap
+    (``in_cols`` overrides; "auto" keeps the format default, "none"
+    drops the column) -> geometry shift -> rounding -> confidence
+    normalization / backfill -> column selection -> optional
+    concatenation (``single_out``) or per-micrograph split
+    (``multi_out``) -> write, or return the DataFrames when
+    ``out_dir`` is None.
+    """
+    global _log_quiet
+    _log_quiet = quiet
+
+    fmt = FORMATS.get(in_fmt)
+    if fmt is None:
+        _log("unknown format", lvl=2)
+
+    colmap = dict(fmt.colmap)
+    if in_cols is not None:
+        for canon, override in zip(COLUMNS, in_cols):
+            if override == "none":
+                colmap[canon] = None
+            elif override != AUTO:
+                colmap[canon] = override
+    _log("using the following input column mapping:")
+    _log(colmap)
+
+    try:
+        raw = {Path(p): fmt.read(p) for p in paths}
+    except pd.errors.ParserError as e:
+        _log(f"input '{in_fmt}' file not properly formatted")
+        _log(repr(e), lvl=2)
+
+    out_dfs = {}
+    for path, df in raw.items():
+        df = _remap_columns(df, colmap)
+        try:
+            df = _shift_geometry(df, fmt, out_fmt, boxsize)
+            df = _round_coords(df, round_to)
+        except KeyError as e:
+            _log(f"didn't find column {e} in input columns "
+                 f"({list(df.columns)})", lvl=2)
+        except (TypeError, ValueError) as e:
+            _log(f"unexpected value in input columns ({e})", lvl=2)
+        df = _normalize_conf(df, norm_conf)
+        if require_conf is not None and "conf" not in df.columns:
+            df["conf"] = float(require_conf)
+
+        if out_fmt in ("star", "tsv"):
+            keep = ["x", "y", "conf", "name"]
+        else:
+            keep = list(COLUMNS)
+        out_dfs[path] = df[[c for c in keep if c in df.columns]]
+
+    if single_out:
+        out_dfs = {Path("all"): pd.concat(out_dfs, ignore_index=True)}
+    if multi_out:
+        if all("name" in df.columns for df in out_dfs.values()):
+            grouped = pd.concat(out_dfs, ignore_index=True).groupby("name")
+            out_dfs = {
+                Path(str(k)): df.drop(columns="name") for k, df in grouped
+            }
+        else:
+            _log("cannot fulfill multi_out without micrograph name "
+                 "information", lvl=1)
+
+    if out_dir is None:
+        return {str(k): v for k, v in out_dfs.items()}
+
+    out_dir = Path(out_dir).resolve()
+    out_dir.mkdir(parents=True, exist_ok=True)
+    in_paths = {Path(p).resolve() for p in paths}
+    for name, df in out_dfs.items():
+        stem = name.stem
+        # Output lands in out_dir; sub-paths from multi_out micrograph
+        # names are flattened under it (reference: 436-454 keeps
+        # relative structure via os.chdir — here we avoid mutating the
+        # process cwd and place everything under out_dir).
+        rel_parent = Path()
+        if name.resolve() not in in_paths and not name.is_absolute():
+            rel_parent = name.parent
+            if rel_parent.is_absolute():
+                rel_parent = Path()
+        parent = out_dir / rel_parent
+        parent.mkdir(parents=True, exist_ok=True)
+        out_path = parent / f"{stem}{suffix}.{out_fmt}"
+        if out_fmt == "star":
+            write_star(df, out_path, force=force)
+        else:
+            _log("using the following output column order:")
+            _log(out_col_order)
+            write_tsv(df, out_col_order, out_path,
+                      include_header=include_header, force=force)
+        _log(f"wrote to {out_path}")
+    return None
+
+
+# --------------------------------------------------------------------
+# CLI (repic-tpu convert; also runnable standalone)
+# --------------------------------------------------------------------
+
+name = "convert"
+
+
+def add_arguments(parser) -> None:
+    parser.add_argument("input", nargs="+",
+                        help="input particle coordinate file(s)")
+    parser.add_argument("out_dir", help="output directory")
+    parser.add_argument("-f", dest="in_fmt", required=True,
+                        choices=sorted(FORMATS),
+                        help="format FROM which to convert")
+    parser.add_argument("-t", dest="out_fmt", required=True,
+                        choices=["star", "box", "tsv"],
+                        help="format TO which to convert")
+    parser.add_argument("-b", dest="boxsize", type=int, default=None,
+                        help="box size (required for centered input "
+                        "-> box output)")
+    parser.add_argument("-c", dest="in_cols", nargs=6, default=None,
+                        metavar=("X", "Y", "W", "H", "CONF", "NAME"),
+                        help="input column overrides ('auto' keeps the "
+                        "format default, 'none' drops the column)")
+    parser.add_argument("-d", dest="out_col_order", nargs=6,
+                        default=list(COLUMNS),
+                        help="output column order (BOX/TSV)")
+    parser.add_argument("-s", dest="suffix", default="",
+                        help="suffix appended to output file stems")
+    parser.add_argument("--header", action="store_true",
+                        help="include column header (BOX/TSV output)")
+    parser.add_argument("--single_out", action="store_true",
+                        help="concatenate everything into one file")
+    parser.add_argument("--multi_out", action="store_true",
+                        help="split output per micrograph name")
+    parser.add_argument("--round", dest="round_to", type=int, default=None)
+    parser.add_argument("--require_conf", type=float, default=None)
+    parser.add_argument("--norm_conf", type=float, nargs=2, default=None)
+    parser.add_argument("--force", action="store_true")
+    parser.add_argument("--quiet", action="store_true")
+
+
+def main(args) -> None:
+    if (
+        args.in_fmt in ("star", "tsv")
+        and args.out_fmt != "star"
+        and args.boxsize is None
+    ):
+        _log(f"box size required for '{args.in_fmt}' input", lvl=2)
+    if args.single_out and args.multi_out:
+        _log("cannot fulfill both single_out and multi_out flags", lvl=2)
+    paths = [Path(p).resolve() for p in args.input]
+    if not all(p.is_file() for p in paths):
+        _log("bad input paths", lvl=2)
+    convert(
+        paths,
+        args.in_fmt,
+        args.out_fmt,
+        boxsize=args.boxsize,
+        out_dir=args.out_dir,
+        in_cols=args.in_cols,
+        out_col_order=tuple(args.out_col_order),
+        suffix=args.suffix,
+        include_header=args.header,
+        single_out=args.single_out,
+        multi_out=args.multi_out,
+        round_to=args.round_to,
+        norm_conf=args.norm_conf,
+        require_conf=args.require_conf,
+        force=args.force,
+        quiet=args.quiet,
+    )
+    _log("done.")
+
+
+if __name__ == "__main__":
+    import argparse
+
+    _parser = argparse.ArgumentParser(description=__doc__)
+    add_arguments(_parser)
+    main(_parser.parse_args())

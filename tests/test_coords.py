@@ -1,0 +1,229 @@
+"""Tests for the coordinate-format converter (utils/coords.py).
+
+Covers the reference converter's semantics
+(reference: repic/utils/coord_converter.py): header skipping, CBOX
+footers, center<->corner shifts, rounding, confidence normalization /
+backfill, single/multi out, STAR read/write round trip.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from repic_tpu.utils import coords
+
+
+def _write(p, text):
+    p.write_text(text)
+    return str(p)
+
+
+BOX_BODY = "10\t20\t180\t180\t0.5\n30\t40\t180\t180\t0.9\n"
+
+
+def test_box_to_star_shifts_corner_to_center(tmp_path):
+    src = _write(tmp_path / "a.box", BOX_BODY)
+    out = coords.convert([src], "box", "star", quiet=True)
+    df = out[next(iter(out))]
+    # corner + w/2 (reference: coord_converter.py:376-380)
+    assert list(df["x"]) == [100.0, 120.0]
+    assert list(df["y"]) == [110.0, 130.0]
+    assert list(df["conf"]) == [0.5, 0.9]
+    assert "w" not in df.columns  # star keeps x,y,conf,name only
+
+
+def test_star_to_box_requires_and_applies_boxsize(tmp_path):
+    star = (
+        "data_\n\nloop_\n"
+        "_rlnCoordinateX #1\n_rlnCoordinateY #2\n"
+        "_rlnAutopickFigureOfMerit #3\n"
+        "100.0\t110.0\t0.7\n"
+    )
+    src = _write(tmp_path / "a.star", star)
+    out = coords.convert([src], "star", "box", boxsize=180, quiet=True)
+    df = out[next(iter(out))]
+    assert list(df["x"]) == [10.0]
+    assert list(df["y"]) == [20.0]
+    assert list(df["w"]) == [180]
+
+
+def test_star_skips_optics_block(tmp_path):
+    star = (
+        "data_optics\n\nloop_\n_rlnVoltage #1\n300.0\n\n"
+        "data_particles\n\nloop_\n"
+        "_rlnCoordinateX #1\n_rlnCoordinateY #2\n"
+        "5.0\t6.0\n"
+    )
+    src = _write(tmp_path / "a.star", star)
+    df = coords.read_star(src)
+    assert list(df["_rlnCoordinateX"]) == [5.0]
+
+
+def test_cbox_footer_rows_dropped(tmp_path):
+    cbox = (
+        "data_cryolo\n\nloop_\n"
+        "_CoordinateX #1\n"
+        "10 20 0 180 180 0 0 0 0.8\n"
+        "30 40 0 180 180 0 0 0 0.6\n"
+    )
+    src = _write(tmp_path / "a.cbox", cbox)
+    df = coords.read_tsv_like(src)
+    assert len(df) == 2
+    out = coords.convert([src], "cbox", "box", quiet=True)
+    got = out[next(iter(out))]
+    assert list(got["conf"]) == [0.8, 0.6]
+    assert list(got["w"]) == [180, 180]
+
+
+def test_cbox_never_geometry_shifted(tmp_path):
+    # Reference parity: the shift branches only fire for star/tsv/cs
+    # and box input (coord_converter.py:366,376) — cbox passes through
+    # unshifted in both directions.
+    cbox = "10 20 0 180 180 0 0 0 0.8\n"
+    src = _write(tmp_path / "a.cbox", cbox)
+    to_star = coords.convert([src], "cbox", "star", quiet=True)
+    df = to_star[next(iter(to_star))]
+    assert list(df["x"]) == [10]
+    to_box = coords.convert([src], "cbox", "box", quiet=True)
+    df = to_box[next(iter(to_box))]
+    assert list(df["x"]) == [10]
+
+
+def test_tsv_to_box_with_rounding(tmp_path):
+    src = _write(tmp_path / "a.tsv", "100.4\t110.6\t0.3\n")
+    out = coords.convert(
+        [src], "tsv", "box", boxsize=100, round_to=0, quiet=True
+    )
+    df = out[next(iter(out))]
+    assert list(df["x"]) == [50]
+    assert df["x"].dtype.kind == "i"
+    assert list(df["y"]) == [61]  # 110.6 - 50 = 60.6 -> 61
+
+
+def test_norm_conf_rescales_out_of_range(tmp_path):
+    src = _write(
+        tmp_path / "a.box",
+        "0\t0\t10\t10\t-4\n0\t0\t10\t10\t2\n0\t0\t10\t10\t8\n",
+    )
+    out = coords.convert(
+        [src], "box", "box", norm_conf=(0.0, 1.0), quiet=True
+    )
+    df = out[next(iter(out))]
+    np.testing.assert_allclose(df["conf"], [0.0, 0.5, 1.0])
+
+
+def test_norm_conf_noop_when_in_range(tmp_path):
+    src = _write(tmp_path / "a.box", "0\t0\t10\t10\t0.4\n0\t0\t10\t10\t0.9\n")
+    out = coords.convert(
+        [src], "box", "box", norm_conf=(0.0, 1.0), quiet=True
+    )
+    df = out[next(iter(out))]
+    # min 0.4 > 0 and max 0.9 <= 1 -> untouched
+    # (reference: coord_converter.py:402 normalizes when old_min <= new_min)
+    assert list(df["conf"]) == [0.4, 0.9]
+
+
+def test_require_conf_backfills_missing(tmp_path):
+    src = _write(tmp_path / "a.tsv", "10\t20\n")
+    # tsv conf default col 2 is absent in a 2-col file
+    out = coords.convert(
+        [src], "tsv", "box", boxsize=10, require_conf=1.0, quiet=True
+    )
+    df = out[next(iter(out))]
+    assert list(df["conf"]) == [1.0]
+
+
+def test_in_cols_override_and_none(tmp_path):
+    src = _write(tmp_path / "a.tsv", "0.9\t10\t20\n")
+    out = coords.convert(
+        [src], "tsv", "box", boxsize=10, quiet=True,
+        in_cols=("1", "2", "auto", "auto", "0", "auto"),
+    )
+    df = out[next(iter(out))]
+    assert list(df["x"]) == [5.0]
+    assert list(df["conf"]) == [0.9]
+
+
+def test_single_out_concatenates(tmp_path):
+    a = _write(tmp_path / "a.box", "10\t20\t8\t8\t0.5\n")
+    b = _write(tmp_path / "b.box", "30\t40\t8\t8\t0.6\n")
+    out = coords.convert([a, b], "box", "box", single_out=True, quiet=True)
+    assert len(out) == 1
+    assert len(next(iter(out.values()))) == 2
+
+
+def test_multi_out_splits_by_name_and_writes(tmp_path):
+    star = (
+        "data_\n\nloop_\n"
+        "_rlnCoordinateX #1\n_rlnCoordinateY #2\n"
+        "_rlnAutopickFigureOfMerit #3\n_rlnMicrographName #4\n"
+        "100.0\t110.0\t0.7\tmic1.mrc\n"
+        "200.0\t210.0\t0.8\tmic2.mrc\n"
+        "300.0\t310.0\t0.9\tmic1.mrc\n"
+    )
+    src = _write(tmp_path / "all.star", star)
+    out_dir = tmp_path / "out"
+    coords.convert(
+        [src], "star", "box", boxsize=100, out_dir=str(out_dir),
+        multi_out=True, force=True, quiet=True,
+    )
+    mic1 = out_dir / "mic1.box"
+    mic2 = out_dir / "mic2.box"
+    assert mic1.is_file() and mic2.is_file()
+    assert len(mic1.read_text().strip().splitlines()) == 2
+    assert len(mic2.read_text().strip().splitlines()) == 1
+
+
+def test_star_write_read_roundtrip(tmp_path):
+    src = _write(tmp_path / "a.box", BOX_BODY)
+    out_dir = tmp_path / "out"
+    coords.convert(
+        [src], "box", "star", out_dir=str(out_dir), force=True, quiet=True
+    )
+    star_path = out_dir / "a.star"
+    assert star_path.is_file()
+    df = coords.read_star(str(star_path))
+    assert list(df["_rlnCoordinateX"]) == [100.0, 120.0]
+    assert list(df["_rlnAutopickFigureOfMerit"]) == [0.5, 0.9]
+
+
+def test_overwrite_requires_force(tmp_path):
+    src = _write(tmp_path / "a.box", BOX_BODY)
+    out_dir = tmp_path / "out"
+    coords.convert([src], "box", "star", out_dir=str(out_dir),
+                   force=True, quiet=True)
+    with pytest.raises(SystemExit):
+        coords.convert([src], "box", "star", out_dir=str(out_dir),
+                       force=False, quiet=True)
+
+
+def test_cs_reader(tmp_path):
+    rec = np.zeros(
+        2,
+        dtype=[("f0", "i8")] * 0
+        + [(f"f{i}", "O") for i in range(12)],
+    )
+    rows = []
+    for i, (fx, fy) in enumerate([(0.25, 0.5), (0.75, 0.1)]):
+        rows.append(
+            (0, 0, 0, np.array([64, 64]), 0, 0, 0, 0,
+             f"mic{i}.mrc".encode(), np.array([1000, 2000]), fx, fy)
+        )
+    arr = np.empty(2, dtype=object)
+    arr[:] = rows
+    path = tmp_path / "p.cs"
+    np.save(str(path), arr, allow_pickle=True)
+    df = coords.read_cs(str(path) + ".npy")
+    np.testing.assert_allclose(df["x"], [0.25 * 2000, 0.75 * 2000])
+    np.testing.assert_allclose(df["y"], [0.5 * 1000, 0.1 * 1000])
+    assert list(df["name"]) == ["mic0.mrc", "mic1.mrc"]
+
+
+def test_cli_registered():
+    from repic_tpu.main import build_parser
+
+    parser = build_parser()
+    args = parser.parse_args(
+        ["convert", "in.box", "outdir", "-f", "box", "-t", "star"]
+    )
+    assert args.in_fmt == "box"
