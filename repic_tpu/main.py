@@ -3,27 +3,39 @@
 Mirrors the reference's subcommand registration protocol — each
 command module exposes ``name``, ``add_arguments(parser)`` and
 ``main(args)`` and is also runnable standalone
-(reference: repic/main.py:17-29) — with the reference's four
-subcommands plus TPU-native additions.
+(reference: repic/main.py:17-29) — with the reference's subcommands
+plus TPU-native additions.
+
+Dispatch is two-phase so that one invocation imports exactly one
+command module: the subcommand token is located first, then only that
+module is loaded.  This keeps ``--help``/``--version`` and host-only
+commands (e.g. ``convert``) free of JAX/XLA startup cost.
 """
 
 import argparse
 import importlib
+import sys
 
 import repic_tpu
 
-# Lazily-imported command modules (keeps `--version` fast and avoids
-# paying jax startup for --help).
-COMMAND_MODULES = [
-    "repic_tpu.commands.get_cliques",
-    "repic_tpu.commands.run_ilp",
-    "repic_tpu.commands.consensus",
-    "repic_tpu.commands.iter_config",
-    "repic_tpu.utils.coords",
-]
+# subcommand name -> implementing module
+COMMANDS = {
+    "get_cliques": "repic_tpu.commands.get_cliques",
+    "run_ilp": "repic_tpu.commands.run_ilp",
+    "consensus": "repic_tpu.commands.consensus",
+    "iter_config": "repic_tpu.commands.iter_config",
+    "convert": "repic_tpu.utils.coords",
+    "score": "repic_tpu.utils.scoring",
+}
 
 
-def build_parser():
+# build_parser(only=STUBS_ONLY): register every subcommand name but
+# import no command module (--help / --version / usage errors).
+STUBS_ONLY = object()
+
+
+def build_parser(only=None):
+    """Parser with all (default), one, or no subcommands materialized."""
     parser = argparse.ArgumentParser(prog="repic-tpu")
     parser.add_argument(
         "--version",
@@ -39,16 +51,23 @@ def build_parser():
     subparsers = parser.add_subparsers(
         title="commands", dest="command", required=True
     )
-    for mod_name in COMMAND_MODULES:
+    for cmd, mod_name in COMMANDS.items():
+        if only is STUBS_ONLY or (only is not None and cmd != only):
+            # visible in help, parseable, but module not imported
+            subparsers.add_parser(cmd)
+            continue
         module = importlib.import_module(mod_name)
-        sub = subparsers.add_parser(module.name)
+        assert module.name == cmd, (cmd, module.name)
+        sub = subparsers.add_parser(cmd)
         module.add_arguments(sub)
         sub.set_defaults(func=module.main)
     return parser
 
 
 def main(argv=None):
-    parser = build_parser()
+    argv = sys.argv[1:] if argv is None else list(argv)
+    chosen = next((a for a in argv if a in COMMANDS), None)
+    parser = build_parser(only=chosen if chosen is not None else STUBS_ONLY)
     args = parser.parse_args(argv)
     if args.platform:
         import jax

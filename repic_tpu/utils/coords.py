@@ -435,15 +435,19 @@ def convert(
     in_paths = {Path(p).resolve() for p in paths}
     for name, df in out_dfs.items():
         stem = name.stem
-        # Output lands in out_dir; sub-paths from multi_out micrograph
-        # names are flattened under it (reference: 436-454 keeps
-        # relative structure via os.chdir — here we avoid mutating the
-        # process cwd and place everything under out_dir).
-        rel_parent = Path()
-        if name.resolve() not in in_paths and not name.is_absolute():
+        # Output lands under out_dir, preserving any directory
+        # structure carried by multi_out micrograph names (absolute
+        # names keep their path minus the anchor) so same-stem
+        # micrographs from different directories cannot collide.
+        # The reference (coord_converter.py:436-454) os.chdir's into
+        # out_dir and can escape it for absolute names; here nothing
+        # ever writes outside out_dir and the cwd is not mutated.
+        if name.resolve() in in_paths:
+            rel_parent = Path()
+        else:
             rel_parent = name.parent
             if rel_parent.is_absolute():
-                rel_parent = Path()
+                rel_parent = rel_parent.relative_to(rel_parent.anchor)
         parent = out_dir / rel_parent
         parent.mkdir(parents=True, exist_ok=True)
         out_path = parent / f"{stem}{suffix}.{out_fmt}"

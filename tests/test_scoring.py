@@ -1,0 +1,153 @@
+"""Tests for the segmentation-mask detection scorer (utils/scoring.py).
+
+The oracle is a direct numpy re-statement of the reference's mask
+painting (reference: repic/utils/score_detections.py:28-48): paint
+each box into a dense array, then compare pixel-wise.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from repic_tpu.utils import scoring
+
+
+def _oracle(gt, pk, h, w):
+    def paint(boxes):
+        arr = np.zeros((h, w), np.int16)
+        for x, y, bw, bh in boxes:
+            arr[max(y, 0): y + bh, max(x, 0): x + bw] = 1
+        return arr
+
+    gt_arr, pk_arr = paint(gt), paint(pk)
+    num_pos = pk_arr.sum()
+    tp = (gt_arr * pk_arr).sum()
+    prec = 0.0 if num_pos == 0 else tp / num_pos
+    gt_area = gt_arr.sum()
+    rec = 0.0 if gt_area == 0 else tp / gt_area
+    f1 = 0.0 if prec == rec == 0.0 else 2 * prec * rec / (prec + rec)
+    return prec, rec, f1, num_pos / (h * w)
+
+
+def _df(boxes, conf=None):
+    df = pd.DataFrame(boxes, columns=["x", "y", "w", "h"])
+    if conf is not None:
+        df["conf"] = conf
+    return df
+
+
+def test_identical_sets_score_perfectly():
+    boxes = [(10, 10, 20, 20), (50, 50, 20, 20)]
+    prec, rec, f1, _ = scoring.get_segmentation_scores(
+        _df(boxes), _df(boxes), mrc_w=100, mrc_h=100
+    )
+    assert prec == rec == f1 == 1.0
+
+
+def test_disjoint_sets_score_zero():
+    prec, rec, f1, pos_frac = scoring.get_segmentation_scores(
+        _df([(0, 0, 10, 10)]), _df([(50, 50, 10, 10)]),
+        mrc_w=100, mrc_h=100,
+    )
+    assert prec == rec == f1 == 0.0
+    assert pos_frac == pytest.approx(100 / 10000)
+
+
+def test_random_boxes_match_numpy_oracle():
+    rng = np.random.default_rng(0)
+    for trial in range(5):
+        h = w = 400
+        n_gt, n_pk = rng.integers(3, 40, size=2)
+        gt = np.column_stack(
+            [
+                rng.integers(0, w - 30, n_gt),
+                rng.integers(0, h - 30, n_gt),
+                np.full(n_gt, 30),
+                np.full(n_gt, 30),
+            ]
+        )
+        pk = np.column_stack(
+            [
+                rng.integers(0, w - 30, n_pk),
+                rng.integers(0, h - 30, n_pk),
+                np.full(n_pk, 30),
+                np.full(n_pk, 30),
+            ]
+        )
+        got = scoring.get_segmentation_scores(
+            _df(gt), _df(pk), mrc_w=w, mrc_h=h
+        )
+        want = _oracle(gt, pk, h, w)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_boxes_overflowing_the_micrograph_are_clipped():
+    # numpy slicing clips out-of-range stops; the kernel must too
+    got = scoring.get_segmentation_scores(
+        _df([(90, 90, 20, 20)]), _df([(90, 90, 20, 20)]),
+        mrc_w=100, mrc_h=100,
+    )
+    assert got[0] == got[1] == 1.0
+    assert got[3] == pytest.approx(100 / 10000)
+
+
+def test_conf_threshold_filters_picker_boxes_only():
+    gt = _df([(0, 0, 10, 10)])
+    pk = _df([(0, 0, 10, 10), (50, 50, 10, 10)], conf=[0.2, 0.9])
+    prec, rec, _, _ = scoring.get_segmentation_scores(
+        gt, pk, conf_thresh=0.5, mrc_w=100, mrc_h=100
+    )
+    # the matching low-conf box is dropped: nothing overlaps gt
+    assert prec == 0.0 and rec == 0.0
+
+
+def test_dims_inferred_from_max_extent():
+    gt = _df([(10, 10, 20, 20)])
+    pk = _df([(10, 10, 20, 20)])
+    prec, rec, f1, pos_frac = scoring.get_segmentation_scores(gt, pk)
+    # inferred dims: 30 x 30 (reference: score_detections.py:21-25)
+    assert pos_frac == pytest.approx(400 / 900)
+    assert prec == rec == 1.0
+
+
+def test_empty_gt_gives_zero_recall_not_nan():
+    got = scoring.get_segmentation_scores(
+        _df(np.zeros((0, 4))), _df([(0, 0, 10, 10)]),
+        mrc_w=50, mrc_h=50,
+    )
+    assert got[1] == 0.0 and not np.isnan(got[1])
+
+
+def test_match_by_stem_allows_picker_suffix():
+    pairs = scoring.match_by_stem(
+        ["/gt/Mic_A.box", "/gt/mic_b.box"],
+        ["/p/mic_a_picked.box", "/p/other.box"],
+    )
+    assert len(pairs) == 1
+    assert pairs[0][0] == "mic_a"
+
+
+def test_cli_end_to_end(tmp_path):
+    gt_dir, p_dir = tmp_path / "gt", tmp_path / "p"
+    gt_dir.mkdir(), p_dir.mkdir()
+    (gt_dir / "m1.box").write_text("10\t10\t20\t20\t1.0\n")
+    (p_dir / "m1.box").write_text("10\t10\t20\t20\t0.9\n")
+    from repic_tpu.main import build_parser
+
+    args = build_parser().parse_args(
+        [
+            "score",
+            "-g", str(gt_dir / "m1.box"),
+            "-p", str(p_dir / "m1.box"),
+            "--out_dir", str(tmp_path / "out"),
+        ]
+    )
+    args.func(args)
+    tsv = (tmp_path / "out" / "particle_set_comp.tsv").read_text()
+    lines = tsv.strip().splitlines()
+    assert lines[0].split("\t") == [
+        "filename", "precision", "recall", "f1", "pos_frac"
+    ]
+    vals = lines[1].split("\t")
+    assert vals[0] == "m1"
+    assert float(vals[1]) == 1.0
