@@ -26,6 +26,7 @@ COMMANDS = {
     "iter_config": "repic_tpu.commands.iter_config",
     "convert": "repic_tpu.utils.coords",
     "score": "repic_tpu.utils.scoring",
+    "build_subsets": "repic_tpu.utils.subsets",
 }
 
 

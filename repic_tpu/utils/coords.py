@@ -448,6 +448,10 @@ def convert(
             rel_parent = name.parent
             if rel_parent.is_absolute():
                 rel_parent = rel_parent.relative_to(rel_parent.anchor)
+            # drop any ".." so the output cannot escape out_dir
+            rel_parent = Path(
+                *[p for p in rel_parent.parts if p not in ("..", ".")]
+            )
         parent = out_dir / rel_parent
         parent.mkdir(parents=True, exist_ok=True)
         out_path = parent / f"{stem}{suffix}.{out_fmt}"

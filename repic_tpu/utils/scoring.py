@@ -27,6 +27,27 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _rasterize_padded(boxes, valid, h, w, hb: int, wb: int):
+    """Difference-array union rasterization into a ``(hb, wb)``
+    static-shape mask; boxes are clipped to the (possibly traced)
+    true dims ``h <= hb``, ``w <= wb`` so padding pixels stay zero."""
+    x0 = jnp.clip(boxes[:, 0], 0, w)
+    y0 = jnp.clip(boxes[:, 1], 0, h)
+    x1 = jnp.clip(boxes[:, 0] + boxes[:, 2], x0, w)
+    y1 = jnp.clip(boxes[:, 1] + boxes[:, 3], y0, h)
+    x1 = jnp.where(valid, x1, x0)
+    y1 = jnp.where(valid, y1, y0)
+    diff = jnp.zeros((hb + 1, wb + 1), jnp.int32)
+    diff = (
+        diff.at[y0, x0].add(1)
+        .at[y0, x1].add(-1)
+        .at[y1, x0].add(-1)
+        .at[y1, x1].add(1)
+    )
+    count = jnp.cumsum(jnp.cumsum(diff, axis=0), axis=1)
+    return count[:hb, :wb] > 0
+
+
 @partial(jax.jit, static_argnames=("h", "w"))
 def rasterize_union(boxes: jax.Array, valid: jax.Array, h: int, w: int):
     """Union mask of axis-aligned boxes via difference-array scatter.
@@ -39,32 +60,23 @@ def rasterize_union(boxes: jax.Array, valid: jax.Array, h: int, w: int):
     Returns:
         ``(h, w)`` bool coverage mask.
     """
-    x0 = jnp.clip(boxes[:, 0], 0, w)
-    y0 = jnp.clip(boxes[:, 1], 0, h)
-    x1 = jnp.clip(boxes[:, 0] + boxes[:, 2], x0, w)
-    y1 = jnp.clip(boxes[:, 1] + boxes[:, 3], y0, h)
-    x1 = jnp.where(valid, x1, x0)
-    y1 = jnp.where(valid, y1, y0)
-    diff = jnp.zeros((h + 1, w + 1), jnp.int32)
-    diff = (
-        diff.at[y0, x0].add(1)
-        .at[y0, x1].add(-1)
-        .at[y1, x0].add(-1)
-        .at[y1, x1].add(1)
-    )
-    count = jnp.cumsum(jnp.cumsum(diff, axis=0), axis=1)
-    return count[:h, :w] > 0
+    return _rasterize_padded(boxes, valid, h, w, h, w)
 
 
-@partial(jax.jit, static_argnames=("h", "w"))
-def segmentation_scores_masked(gt_boxes, gt_valid, p_boxes, p_valid, h, w):
+@partial(jax.jit, static_argnames=("hb", "wb"))
+def segmentation_scores_masked(
+    gt_boxes, gt_valid, p_boxes, p_valid, h, w, hb: int, wb: int
+):
     """(precision, recall, f1, pos_frac) between two box sets.
 
     Same metric definitions as the reference
     (score_detections.py:40-48); all-zero denominators yield 0.0.
+    Only the bucketed mask dims ``(hb, wb)`` are compile-time static;
+    the true micrograph dims ``(h, w)`` are traced operands, so
+    per-micrograph inferred sizes share one executable per bucket.
     """
-    gt = rasterize_union(gt_boxes, gt_valid, h, w)
-    p = rasterize_union(p_boxes, p_valid, h, w)
+    gt = _rasterize_padded(gt_boxes, gt_valid, h, w, hb, wb)
+    p = _rasterize_padded(p_boxes, p_valid, h, w, hb, wb)
     num_pos = p.sum()
     gt_area = gt.sum()
     tp = (gt & p).sum()
@@ -99,35 +111,38 @@ def get_segmentation_scores(
     """
     gt = _to_int_boxes(gt_df)
     pk = _to_int_boxes(pckr_df)
+
+    def _extent(df, pos, size):
+        if len(df) == 0:
+            return 0
+        vals = df[pos].to_numpy(float) + df[size].to_numpy(float)
+        # the reference rounds the float extent, not its parts
+        # (score_detections.py:22-25)
+        return int(np.rint(vals.max()))
+
     if mrc_w is None:
-        mrc_w = int(
-            max(
-                (gt[:, 0] + gt[:, 2]).max(initial=0),
-                (pk[:, 0] + pk[:, 2]).max(initial=0),
-            )
-        )
+        mrc_w = max(_extent(gt_df, "x", "w"), _extent(pckr_df, "x", "w"))
     if mrc_h is None:
-        mrc_h = int(
-            max(
-                (gt[:, 1] + gt[:, 3]).max(initial=0),
-                (pk[:, 1] + pk[:, 3]).max(initial=0),
-            )
-        )
+        mrc_h = max(_extent(gt_df, "y", "h"), _extent(pckr_df, "y", "h"))
     if conf_thresh is not None:
         pk = _to_int_boxes(pckr_df, conf_thresh)
 
-    # Pad the particle axis to a bucket size so jit re-compiles per
-    # (H, W, bucket), not per particle count.
+    # Pad the particle axis and the mask dims to bucket sizes so jit
+    # re-compiles per bucket, not per particle count / micrograph size.
     def pad(a):
         n = max(64, 1 << (int(a.shape[0]) - 1).bit_length())
         out = np.zeros((n, 4), np.int32)
         out[: a.shape[0]] = a
         return out, np.arange(n) < a.shape[0]
 
+    def bucket(dim, step=512):
+        return max(step, -(-dim // step) * step)
+
     gt_p, gt_v = pad(gt)
     pk_p, pk_v = pad(pk)
     prec, rec, f1, pos_frac = segmentation_scores_masked(
-        gt_p, gt_v, pk_p, pk_v, mrc_h, mrc_w
+        gt_p, gt_v, pk_p, pk_v, mrc_h, mrc_w,
+        bucket(mrc_h), bucket(mrc_w),
     )
     return float(prec), float(rec), float(f1), float(pos_frac)
 

@@ -1,0 +1,193 @@
+"""Tests for MRC I/O (utils/mrc.py) and dataset splitting
+(utils/subsets.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from repic_tpu.utils import mrc as mrc_io
+from repic_tpu.utils import subsets
+
+
+# ------------------------- MRC I/O -------------------------
+
+
+def test_mrc_roundtrip_2d(tmp_path):
+    img = np.random.default_rng(0).normal(size=(48, 64)).astype(np.float32)
+    path = str(tmp_path / "a.mrc")
+    mrc_io.write_mrc(path, img)
+    got = mrc_io.read_mrc(path)
+    assert got.shape == (48, 64)
+    np.testing.assert_array_equal(got, img)
+
+
+def test_mrc_roundtrip_stack(tmp_path):
+    vol = np.arange(2 * 4 * 6, dtype=np.float32).reshape(2, 4, 6)
+    path = str(tmp_path / "v.mrc")
+    mrc_io.write_mrc(path, vol)
+    got = mrc_io.read_mrc(path)
+    assert got.shape == (2, 4, 6)
+    np.testing.assert_array_equal(got, vol)
+
+
+def test_mrc_int16_mode(tmp_path):
+    # hand-build a mode-1 file
+    img = np.arange(12, dtype="<i2").reshape(3, 4)
+    header = np.zeros(256, dtype="<i4")
+    header[0:4] = (4, 3, 1, 1)
+    header[53] = 0x00004444
+    path = str(tmp_path / "i16.mrc")
+    with open(path, "wb") as f:
+        f.write(header.tobytes())
+        f.write(img.tobytes())
+    got = mrc_io.read_mrc(path)
+    np.testing.assert_array_equal(got, img)
+
+
+def test_mrc_extended_header_skipped(tmp_path):
+    img = np.ones((2, 2), dtype="<f4")
+    header = np.zeros(256, dtype="<i4")
+    header[0:4] = (2, 2, 1, 2)
+    header[23] = 128  # nsymbt
+    header[53] = 0x00004444
+    path = str(tmp_path / "ext.mrc")
+    with open(path, "wb") as f:
+        f.write(header.tobytes())
+        f.write(b"\xaa" * 128)
+        f.write(img.tobytes())
+    np.testing.assert_array_equal(mrc_io.read_mrc(path), img)
+
+
+def test_mrc_rejects_garbage(tmp_path):
+    path = str(tmp_path / "bad.mrc")
+    with open(path, "wb") as f:
+        f.write(b"not an mrc file")
+    with pytest.raises(mrc_io.MrcError):
+        mrc_io.read_header(path)
+    assert not mrc_io.is_single_frame_micrograph(path)
+
+
+def test_is_single_frame(tmp_path):
+    p2d = str(tmp_path / "a.mrc")
+    mrc_io.write_mrc(p2d, np.zeros((4, 4), np.float32))
+    p3d = str(tmp_path / "b.mrc")
+    mrc_io.write_mrc(p3d, np.zeros((3, 4, 4), np.float32))
+    assert mrc_io.is_single_frame_micrograph(p2d)
+    assert not mrc_io.is_single_frame_micrograph(p3d)
+
+
+# ------------------------- subsets -------------------------
+
+
+def _fake_data(n, seed=1):
+    rng = np.random.default_rng(seed)
+    return [(f"mic_{i:03d}.mrc", float(d))
+            for i, d in enumerate(rng.uniform(1e4, 4e4, n))]
+
+
+def test_tertile_split_partitions():
+    data = _fake_data(50)
+    low, med, high = subsets.tertile_split(data)
+    assert len(low) + len(med) + len(high) == 50
+    assert max(d for _, d in low) <= min(d for _, d in med)
+    assert max(d for _, d in med) <= min(d for _, d in high)
+
+
+def test_calc_subsets_monotone():
+    d = subsets.calc_subsets(60)
+    assert d[100] == 60
+    vals = list(d.values())
+    assert vals == sorted(vals)
+    for tgt, s in d.items():
+        if tgt != 100:
+            assert s / 60 * 100 <= tgt
+
+
+def test_split_dataset_partition_and_determinism():
+    data = _fake_data(60)
+    t1, v1, te1, sub1 = subsets.split_dataset(data)
+    t2, v2, te2, _ = subsets.split_dataset(data)
+    assert (t1, v1, te1) == (t2, v2, te2)
+    assert len(t1) == round(0.2 * 60)
+    assert len(v1) == 6
+    assert len(t1) + len(v1) + len(te1) == 60
+    names = [f for f, _ in t1 + v1 + te1]
+    assert len(set(names)) == 60
+    # train spans the defocus distribution: all three tertiles present
+    low, med, high = subsets.tertile_split(data)
+    for tert in (low, med, high):
+        tert_names = {f for f, _ in tert}
+        assert tert_names & set(f for f, _ in t1)
+
+
+def test_split_dataset_ignore_test():
+    data = _fake_data(30)
+    train, val, test, sub = subsets.split_dataset(data, ignore_test=True)
+    assert test == []
+    assert len(train) == 30 - 6
+    assert list(sub.keys()) == [100]
+
+
+def test_cli_end_to_end(tmp_path):
+    box_dir = tmp_path / "box"
+    mrc_dir = tmp_path / "mrc"
+    out_dir = tmp_path / "out"
+    box_dir.mkdir(), mrc_dir.mkdir()
+    n = 40
+    defocus_lines = []
+    rng = np.random.default_rng(2)
+    for i in range(n):
+        base = f"mic_{i:03d}"
+        mrc_io.write_mrc(
+            str(mrc_dir / f"{base}.mrc"), np.zeros((8, 8), np.float32)
+        )
+        (box_dir / f"{base}.box").write_text("1\t1\t4\t4\t0.5\n")
+        d = rng.uniform(1e4, 4e4)
+        defocus_lines.append(f"{base}.mrc\t{d:.1f}\t{d:.1f}")
+    defocus_file = tmp_path / "defocus.txt"
+    defocus_file.write_text("\n".join(defocus_lines) + "\n")
+
+    from repic_tpu.main import build_parser
+
+    args = build_parser().parse_args(
+        ["build_subsets", str(defocus_file), str(box_dir),
+         str(mrc_dir), str(out_dir)]
+    )
+    args.func(args)
+
+    train_100 = out_dir / "train" / "train_100"
+    assert train_100.is_dir()
+    mrcs = [f for f in os.listdir(train_100) if f.endswith(".mrc")]
+    boxes = [f for f in os.listdir(train_100) if f.endswith(".box")]
+    assert len(mrcs) == round(0.2 * n)
+    assert len(boxes) == len(mrcs)
+    assert all(os.path.islink(train_100 / f) for f in mrcs)
+    assert len(os.listdir(out_dir / "val")) == 2 * 6
+    test_n = len(
+        [f for f in os.listdir(out_dir / "test") if f.endswith(".mrc")]
+    )
+    assert test_n == n - round(0.2 * n) - 6
+    # defocus plot written next to the defocus file
+    assert (tmp_path / "defocus.png").is_file()
+
+
+def test_cli_fallback_scan_without_defocus(tmp_path, capsys):
+    box_dir = tmp_path / "box"
+    mrc_dir = tmp_path / "mrc"
+    box_dir.mkdir(), mrc_dir.mkdir()
+    for i in range(12):
+        mrc_io.write_mrc(
+            str(mrc_dir / f"m{i}.mrc"), np.zeros((4, 4), np.float32)
+        )
+    (mrc_dir / "junk.txt").write_text("nope")
+    from repic_tpu.main import build_parser
+
+    args = build_parser().parse_args(
+        ["build_subsets", str(tmp_path / "missing.txt"), str(box_dir),
+         str(mrc_dir), str(tmp_path / "out"), "--ignore_test"]
+    )
+    args.func(args)
+    out = capsys.readouterr().out
+    assert "12 valid MRC files found" in out
+    assert (tmp_path / "out" / "train").is_dir()
