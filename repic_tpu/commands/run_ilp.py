@@ -71,9 +71,9 @@ def _solve(a_mat, w, backend):
         if len(col) < k:
             mv[j, len(col) :] = extra + j  # unique, conflict-free
     if backend == "exact":
-        from repic_tpu.ops.solver import solve_exact_py
+        from repic_tpu.ops.solver import solve_exact
 
-        return solve_exact_py(mv, np.asarray(w, np.float64))
+        return solve_exact(mv, np.asarray(w, np.float64))
     import jax.numpy as jnp
 
     from repic_tpu.ops.solver import solve_greedy

@@ -1,0 +1,142 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's only native-code dependency is the commercial Gurobi
+ILP core reached through ``gurobipy`` (reference: repic/commands/
+run_ilp.py:7,50-63).  This package provides the framework's own native
+equivalent: an exact branch-and-bound set-packing solver compiled from
+``setpack.cpp``.  Compilation happens lazily on first use (``g++ -O2
+-shared -fPIC``) and the resulting shared object is cached next to the
+source; everything degrades gracefully to the pure-Python oracle in
+:mod:`repic_tpu.ops.solver` when no C++ toolchain is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "setpack.cpp")
+_LOCK = threading.Lock()
+_LIB: ctypes.CDLL | None = None
+_LOAD_FAILED = False
+
+
+def _so_path() -> str:
+    return os.path.join(_HERE, "_setpack.so")
+
+
+def _build(force: bool = False) -> str | None:
+    """Compile setpack.cpp to a shared object; return its path or None."""
+    so = _so_path()
+    tmp = None
+    try:
+        if (
+            not force
+            and os.path.exists(so)
+            and os.path.getmtime(so) >= os.path.getmtime(_SRC)
+        ):
+            return so
+        # Build into a temp file then atomically rename, so concurrent
+        # processes never load a half-written object.
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+        os.close(fd)
+        subprocess.run(
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, so)
+        return so
+    except (OSError, subprocess.SubprocessError):
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return None
+
+
+def _load() -> ctypes.CDLL | None:
+    global _LIB, _LOAD_FAILED
+    if _LIB is not None or _LOAD_FAILED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _LOAD_FAILED:
+            return _LIB
+        for attempt in range(2):
+            # Second attempt force-rebuilds: a stale or foreign-arch
+            # .so (e.g. restored by a checkout) fails CDLL but a fresh
+            # local compile may succeed.
+            so = _build(force=attempt > 0)
+            if so is None:
+                break
+            try:
+                lib = ctypes.CDLL(so)
+                lib.setpack_solve.restype = ctypes.c_int32
+                lib.setpack_solve.argtypes = [
+                    ctypes.POINTER(ctypes.c_int32),
+                    ctypes.POINTER(ctypes.c_double),
+                    ctypes.c_int64,
+                    ctypes.c_int32,
+                    ctypes.c_int64,
+                    ctypes.POINTER(ctypes.c_uint8),
+                ]
+                _LIB = lib
+                break
+            except OSError:
+                continue
+        if _LIB is None:
+            _LOAD_FAILED = True
+    return _LIB
+
+
+def native_available() -> bool:
+    """True when the compiled solver is (or can be made) loadable."""
+    return _load() is not None
+
+
+def solve_exact_native(
+    member_vertex: np.ndarray,
+    w: np.ndarray,
+    *,
+    node_limit: int = 2_000_000,
+) -> np.ndarray | None:
+    """Exact max-weight set packing via the C++ core.
+
+    Same contract as :func:`repic_tpu.ops.solver.solve_exact_py`;
+    returns None when the native library is unavailable so callers can
+    fall back.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    src = np.asarray(member_vertex)
+    if src.size and (src.min() < 0 or src.max() >= np.iinfo(np.int32).max):
+        raise ValueError(
+            "vertex ids must be in [0, 2**31-1); got range "
+            f"[{src.min()}, {src.max()}]"
+        )
+    mv = np.ascontiguousarray(src, dtype=np.int32)
+    ww = np.ascontiguousarray(w, dtype=np.float64)
+    if mv.ndim != 2 or len(ww) != mv.shape[0]:
+        raise ValueError(f"bad shapes: member_vertex {mv.shape}, w {ww.shape}")
+    C, K = mv.shape
+    out = np.zeros(C, dtype=np.uint8)
+    rc = lib.setpack_solve(
+        mv.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        ww.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int64(C),
+        ctypes.c_int32(K),
+        ctypes.c_int64(node_limit),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    if rc < 0:
+        raise RuntimeError(f"setpack_solve failed with rc={rc}")
+    return out.astype(bool)

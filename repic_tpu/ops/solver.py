@@ -239,6 +239,31 @@ def solve_exact_py(
     return picked
 
 
+def solve_exact(
+    member_vertex: np.ndarray,
+    w: np.ndarray,
+    *,
+    node_limit: int = 2_000_000,
+) -> np.ndarray:
+    """Exact max-weight set packing, preferring the native C++ core.
+
+    Dispatches to :func:`repic_tpu.native.solve_exact_native` (the
+    framework's compiled replacement for the Gurobi core at reference
+    run_ilp.py:50-63) and falls back to :func:`solve_exact_py` when no
+    C++ toolchain is available.
+    """
+    from repic_tpu import native
+
+    out = native.solve_exact_native(
+        np.asarray(member_vertex), np.asarray(w), node_limit=node_limit
+    )
+    if out is not None:
+        return out
+    return solve_exact_py(
+        np.asarray(member_vertex), np.asarray(w), node_limit=node_limit
+    )
+
+
 def pack_cliques_for_solver(member_idx, valid, num_per_picker):
     """Map per-picker particle indices to global vertex ids.
 
