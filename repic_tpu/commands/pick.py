@@ -1,0 +1,137 @@
+"""``pick`` subcommand — run the in-framework CNN picker over MRCs.
+
+Capability-parity with the reference's DeepPicker invocation
+(reference: docs/patches/deeppicker/autoPick.py:24-115, driven by
+run_deep.sh:22-28): score every micrograph in a directory with a
+trained model and write per-micrograph coordinate files.  Output is
+BOX (default, the format the consensus stage consumes) or STAR (the
+reference picker's native output, autoPicker.py:278+).
+
+Unlike the reference there is no conda-env / GPU-process boundary:
+the model is a Flax module jitted once and reused across micrographs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+import time
+
+import numpy as np
+
+name = "pick"
+
+
+def add_arguments(parser) -> None:
+    parser.add_argument(
+        "model", help="picker checkpoint (from `repic-tpu fit`)"
+    )
+    parser.add_argument(
+        "mrc_dir", help="directory of .mrc micrographs"
+    )
+    parser.add_argument("out_dir", help="output coordinate directory")
+    parser.add_argument(
+        "--particle_size",
+        type=int,
+        default=None,
+        help="particle box size in px (default: from the checkpoint)",
+    )
+    parser.add_argument(
+        "--threshold",
+        type=float,
+        default=0.0,
+        help="min classifier score to keep (reference applies 0.0, "
+        "run_deep.sh:26)",
+    )
+    parser.add_argument(
+        "--mode",
+        choices=["patch", "fcn"],
+        default="patch",
+        help="patch = reference-parity dense windows; fcn = "
+        "fully-convolutional fast path",
+    )
+    parser.add_argument(
+        "--format",
+        choices=["box", "star"],
+        default="box",
+        help="output coordinate format",
+    )
+
+
+def _write_star(path: str, coords: np.ndarray) -> None:
+    """RELION particle STAR with centers + score, mirroring the
+    vendored picker's writer (autoPicker.py:278+)."""
+    with open(path, "wt") as f:
+        f.write("\ndata_\n\nloop_\n")
+        f.write("_rlnCoordinateX #1\n_rlnCoordinateY #2\n")
+        f.write("_rlnAutopickFigureOfMerit #3\n")
+        for x, y, s in coords:
+            f.write(f"{x:.6f}\t{y:.6f}\t{s:.6f}\n")
+
+
+def main(args) -> None:
+    from repic_tpu.models.checkpoint import load_checkpoint
+    from repic_tpu.models.infer import pick_micrograph
+    from repic_tpu.utils import mrc
+    from repic_tpu.utils.box_io import write_box
+
+    params, meta = load_checkpoint(args.model)
+    particle_size = args.particle_size or meta.get("particle_size")
+    if not particle_size:
+        sys.exit(
+            "error: checkpoint has no particle_size; pass --particle_size"
+        )
+    norm = meta.get("patch_norm", "reference")
+    if args.mode == "fcn" and norm != "global":
+        print(
+            "warning: fcn mode assumes global patch normalization but "
+            f"the checkpoint was trained with {norm!r}; scores will "
+            "be approximate",
+            file=sys.stderr,
+        )
+
+    mrcs = sorted(glob.glob(os.path.join(args.mrc_dir, "*.mrc")))
+    if not mrcs:
+        sys.exit(f"error: no .mrc files in {args.mrc_dir}")
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    for path in mrcs:
+        t0 = time.time()
+        raw = mrc.read_mrc(path).astype(np.float32)
+        if raw.ndim == 3:  # single-frame stack
+            raw = raw[0]
+        coords = pick_micrograph(
+            params,
+            raw,
+            int(particle_size),
+            mode=args.mode,
+            norm=norm,
+        )
+        coords = coords[coords[:, 2] >= args.threshold]
+        stem = os.path.splitext(os.path.basename(path))[0]
+        if args.format == "star":
+            _write_star(
+                os.path.join(args.out_dir, stem + ".star"), coords
+            )
+        else:
+            # BOX rows are lower-left corners (center - size/2),
+            # matching the converter's center->corner shift
+            # (reference coord_converter.py:366-374).
+            write_box(
+                os.path.join(args.out_dir, stem + ".box"),
+                coords[:, :2] - particle_size / 2,
+                coords[:, 2],
+                int(particle_size),
+            )
+        print(
+            f"{stem}: {len(coords)} particles "
+            f"({time.time() - t0:.1f}s)"
+        )
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    add_arguments(parser)
+    main(parser.parse_args())

@@ -24,6 +24,7 @@ COMMANDS = {
     "run_ilp": "repic_tpu.commands.run_ilp",
     "consensus": "repic_tpu.commands.consensus",
     "iter_config": "repic_tpu.commands.iter_config",
+    "pick": "repic_tpu.commands.pick",
     "convert": "repic_tpu.utils.coords",
     "score": "repic_tpu.utils.scoring",
     "build_subsets": "repic_tpu.utils.subsets",

@@ -1,0 +1,13 @@
+from repic_tpu.models.cnn import (
+    PickerCNN,
+    PickerFCN,
+    fc_params_as_conv,
+    fc_l2_penalty,
+)
+
+__all__ = [
+    "PickerCNN",
+    "PickerFCN",
+    "fc_params_as_conv",
+    "fc_l2_penalty",
+]

@@ -1,0 +1,46 @@
+"""Picker model checkpointing (msgpack via flax.serialization).
+
+The reference's pickers each have their own checkpoint formats
+(crYOLO ``.h5`` run.sh:243, DeepPicker TF checkpoints run.sh:271 with
+best-val-error saving train.py:213-219, Topaz ``.sav`` run.sh:300).
+The in-framework picker uses one self-describing file: a msgpack blob
+holding the param pytree plus a metadata dict (particle size, patch
+normalization mode, training provenance) so ``pick`` can validate
+compatibility before scoring.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+from flax import serialization
+
+MAGIC = b"RPTPU1\n"
+
+
+def save_checkpoint(path: str, params, meta: dict) -> None:
+    """Write params + metadata atomically."""
+    params = jax.tree_util.tree_map(np.asarray, params)
+    blob = serialization.msgpack_serialize(
+        {"params": params, "meta_json": json.dumps(meta)}
+    )
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(MAGIC)
+        f.write(blob)
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str):
+    """Returns (params, meta dict)."""
+    with open(path, "rb") as f:
+        head = f.read(len(MAGIC))
+        if head != MAGIC:
+            raise ValueError(
+                f"{path}: not a repic-tpu checkpoint (bad magic {head!r})"
+            )
+        tree = serialization.msgpack_restore(f.read())
+    return tree["params"], json.loads(tree["meta_json"])

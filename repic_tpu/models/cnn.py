@@ -1,0 +1,130 @@
+"""In-framework CNN particle picker — the model.
+
+The reference vendors a patched DeepPicker: a TF1-graph binary
+classifier over 64x64 particle patches (reference:
+docs/patches/deeppicker/deepModel.py:63-99,143-175) with
+
+    conv 9x9x8  -> relu -> maxpool 2x2   (all VALID)
+    conv 5x5x16 -> relu -> maxpool 2x2
+    conv 3x3x32 -> relu -> maxpool 2x2
+    conv 2x2x64 -> relu -> maxpool 2x2
+    flatten(256) -> fc 128 relu -> fc num_class
+    dropout 0.5 on the flattened features during training
+    L2 weight decay 5e-4 on the two FC weight matrices only
+
+Here the same capability is a pair of Flax modules compiled by XLA
+that share one parameter set: :class:`PickerCNN` scores patch batches
+(training + parity inference), and :class:`PickerFCN` runs the same
+weights fully convolutionally over a whole micrograph — the conv
+stack is computed once and the FC head slides as a windowed conv,
+the TPU-fast replacement for the reference's dense
+``view_as_windows`` patch loop (autoPicker.py:164-197).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# (kernel_size, features) per conv block, matching the reference
+# filter pyramid (deepModel.py:143-162).
+CONV_SPEC = ((9, 8), (5, 16), (3, 32), (2, 64))
+PATCH_SIZE = 64  # model input resolution (autoPick.py:48 model_input_size)
+FC_WIDTH = 128
+FC_WEIGHT_DECAY = 5e-4  # deepModel.py:164-173 (FC weights only)
+# 64x64 -> 2x2x64 after four VALID conv+pool blocks.
+FEAT_SPATIAL = 2
+FEAT_CHANNELS = CONV_SPEC[-1][1]
+# Output stride of the fully-convolutional head: product of the four
+# pool strides.
+FCN_STRIDE = 16
+
+
+class Backbone(nn.Module):
+    """The four VALID conv+pool blocks shared by both heads."""
+
+    @nn.compact
+    def __call__(self, x):
+        for i, (k, f) in enumerate(CONV_SPEC):
+            x = nn.Conv(f, (k, k), padding="VALID", name=f"conv{i + 1}")(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2), padding="VALID")
+        return x
+
+
+class PickerCNN(nn.Module):
+    """Binary particle/background classifier over 64x64 patches.
+
+    Input:  ``(B, 64, 64, 1)`` float32 standardized patches.
+    Output: ``(B, num_class)`` logits.
+    """
+
+    num_class: int = 2
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False):
+        x = Backbone(name="backbone")(x)
+        x = x.reshape(x.shape[0], -1)
+        if train:
+            x = nn.Dropout(rate=0.5, deterministic=False)(x)
+        x = nn.relu(nn.Dense(FC_WIDTH, name="fc1")(x))
+        return nn.Dense(self.num_class, name="fc2")(x)
+
+
+class PickerFCN(nn.Module):
+    """The same classifier applied at every 64x64 window, stride 16.
+
+    Input:  ``(B, H, W, 1)`` with ``H, W >= 64``.
+    Output: ``(B, H', W', num_class)`` logits per window.
+
+    Use :func:`fc_params_as_conv` to map trained :class:`PickerCNN`
+    parameters onto this module.
+    """
+
+    num_class: int = 2
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray):
+        x = Backbone(name="backbone")(x)
+        # fc1 as a 2x2 VALID conv over the feature map == Dense on the
+        # flattened 2x2x64 window at each output position.
+        x = nn.Conv(
+            FC_WIDTH,
+            (FEAT_SPATIAL, FEAT_SPATIAL),
+            padding="VALID",
+            name="fc1_conv",
+        )(x)
+        x = nn.relu(x)
+        return nn.Conv(self.num_class, (1, 1), name="fc2_conv")(x)
+
+
+def fc_params_as_conv(params: dict) -> dict:
+    """Re-shape trained PickerCNN params for :class:`PickerFCN`.
+
+    ``fc1`` has kernel ``(256, 128)`` where 256 flattens a 2x2x64
+    feature window in (row, col, channel) order; the equivalent conv
+    kernel is ``(2, 2, 64, 128)``.  ``fc2`` becomes a 1x1 conv.  The
+    backbone transfers unchanged.
+    """
+    p = dict(params)
+    fc1 = p.pop("fc1")
+    fc2 = p.pop("fc2")
+    p["fc1_conv"] = {
+        "kernel": fc1["kernel"].reshape(
+            FEAT_SPATIAL, FEAT_SPATIAL, FEAT_CHANNELS, FC_WIDTH
+        ),
+        "bias": fc1["bias"],
+    }
+    p["fc2_conv"] = {
+        "kernel": fc2["kernel"][None, None, :, :],
+        "bias": fc2["bias"],
+    }
+    return p
+
+
+def fc_l2_penalty(params: dict) -> jnp.ndarray:
+    """L2 weight decay on FC kernels only (deepModel.py:164-173)."""
+    return FC_WEIGHT_DECAY * (
+        0.5 * jnp.sum(params["fc1"]["kernel"] ** 2)
+        + 0.5 * jnp.sum(params["fc2"]["kernel"] ** 2)
+    )

@@ -1,0 +1,282 @@
+"""Whole-micrograph particle picking with the in-framework CNN.
+
+Pipeline (capability-parity with the reference's vendored DeepPicker,
+reference: docs/patches/deeppicker/autoPicker.py:133-275):
+
+    read MRC -> preprocess (blur, 3x bin, z-score)
+    -> score every sliding 64x64 window (stride 4 on the binned image)
+    -> local-maximum peak detection + greedy suppression
+    -> upscale coordinates back to the original pixel grid
+
+Two scoring paths share one set of trained weights:
+
+* ``mode="patch"`` — reference-parity: dense stride-4 patches, each
+  bytescaled / resized / standardized independently, scored by
+  :class:`PickerCNN` in large fused batches.  This replaces the
+  reference's host-side ``view_as_windows`` + torch loop with one
+  jitted scan whose inner batch rides the MXU.
+* ``mode="fcn"`` — TPU-fast: the micrograph is scored by
+  :class:`PickerFCN` (conv stack computed once, FC head as windowed
+  conv) over ``step``-shifted copies to fill in the stride-16 ->
+  stride-4 grid.  Uses global (micrograph-level) standardization, so
+  it is exact only for models trained with
+  ``patch_norm="global"``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from repic_tpu.models.cnn import (
+    FCN_STRIDE,
+    PATCH_SIZE,
+    PickerCNN,
+    PickerFCN,
+    fc_params_as_conv,
+)
+from repic_tpu.models import preprocess as pp
+
+STEP_SIZE = 4  # autoPicker.py:159 step_size
+ROW_CHUNK = 8  # scored rows per device launch (batch = ROW_CHUNK * out_w)
+
+
+def score_grid_shape(shape, patch_size: int, step: int = STEP_SIZE):
+    """(out_h, out_w) of the sliding-window score map."""
+    return (
+        (shape[0] - patch_size) // step + 1,
+        (shape[1] - patch_size) // step + 1,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("patch_size", "step", "norm")
+)
+def score_micrograph_patches(
+    params, img, *, patch_size: int, step: int = STEP_SIZE,
+    norm: str = "reference",
+):
+    """Dense sliding-window scoring via the patch classifier.
+
+    Args:
+        params: trained :class:`PickerCNN` params.
+        img: ``(H, W)`` preprocessed (binned, z-scored) micrograph.
+        patch_size: window size on the binned grid
+            (``particle_size // BIN_SIZE``).
+        step: window stride (reference fixes 4).
+        norm: ``"reference"`` = bytescale+resize+standardize per patch
+            (autoPicker.py:170-193); ``"global"`` = resize only (the
+            micrograph is already z-scored).
+
+    Returns:
+        ``(out_h, out_w)`` positive-class probabilities.
+    """
+    H, W = img.shape
+    out_h, out_w = score_grid_shape(img.shape, patch_size, step)
+    row_chunk = min(ROW_CHUNK, out_h)
+    model = PickerCNN()
+
+    col_starts = jnp.arange(out_w) * step
+    col_idx = col_starts[:, None] + jnp.arange(patch_size)[None, :]
+
+    def score_rows(i0):
+        # A band of row_chunk consecutive output rows -> one batch.
+        band = jax.lax.dynamic_slice(
+            img, (i0 * step, 0),
+            ((row_chunk - 1) * step + patch_size, W),
+        )
+        row_starts = jnp.arange(row_chunk) * step
+        row_idx = row_starts[:, None] + jnp.arange(patch_size)[None, :]
+        # (row_chunk, patch, W) -> (row_chunk, out_w, patch, patch)
+        rows = band[row_idx]
+        patches = jnp.moveaxis(rows[:, :, col_idx], 2, 1)
+        patches = patches.reshape(-1, patch_size, patch_size)
+        if norm == "reference":
+            x = pp.prepare_patches(patches, PATCH_SIZE)
+        else:
+            x = pp.resize_patches(patches, PATCH_SIZE)
+        logits = model.apply({"params": params}, x[..., None])
+        prob = jax.nn.softmax(logits, axis=-1)[:, 1]
+        return prob.reshape(row_chunk, out_w)
+
+    n_chunks = -(-out_h // row_chunk)
+    # Chunk starts are clamped so the final (partial) chunk re-scores
+    # the last full band instead of reading out of bounds.
+    starts = jnp.minimum(
+        jnp.arange(n_chunks) * row_chunk, max(out_h - row_chunk, 0)
+    )
+    chunks = jax.lax.map(score_rows, starts)
+
+    row_of_chunk = starts[:, None] + jnp.arange(row_chunk)[None, :]
+    flat = chunks.reshape(-1, out_w)
+    out = jnp.zeros((out_h, out_w), flat.dtype)
+    return out.at[row_of_chunk.reshape(-1)].set(flat)
+
+
+@functools.partial(jax.jit, static_argnames=("patch_size", "step"))
+def score_micrograph_fcn(
+    fcn_params, img, *, patch_size: int, step: int = STEP_SIZE
+):
+    """Fully-convolutional scoring with stride-``step`` shift filling.
+
+    The FCN's natural output stride is 16; scoring ``(16/step)^2``
+    shifted copies and interleaving recovers the dense stride-``step``
+    grid while still sharing the conv stack within each copy.
+    Patches are resized from ``patch_size`` to 64 implicitly by
+    scaling the image once (global normalization).
+    """
+    model = PickerFCN()
+    # Resize the whole micrograph so each patch_size window maps to a
+    # 64x64 window; then the FCN scores all windows at once.
+    H, W = img.shape
+    scale = PATCH_SIZE / patch_size
+    sh, sw = int(round(H * scale)), int(round(W * scale))
+    scaled = jax.image.resize(img, (sh, sw), "linear", antialias=True)
+    sstep = max(1, int(round(step * scale)))
+
+    n_shift = FCN_STRIDE // sstep
+    out_h = (sh - PATCH_SIZE) // sstep + 1
+    out_w = (sw - PATCH_SIZE) // sstep + 1
+
+    def one_shift(shift):
+        dy, dx = shift // n_shift, shift % n_shift
+        sub = jax.lax.dynamic_slice(
+            scaled,
+            (dy * sstep, dx * sstep),
+            (sh - (n_shift - 1) * sstep, sw - (n_shift - 1) * sstep),
+        )
+        logits = model.apply({"params": fcn_params}, sub[None, ..., None])
+        return jax.nn.softmax(logits, axis=-1)[0, :, :, 1]
+
+    shifts = jnp.arange(n_shift * n_shift)
+    maps = jax.lax.map(one_shift, shifts)  # (S, h16, w16)
+    h16, w16 = maps.shape[1], maps.shape[2]
+    # Interleave: out[dy + i*n, dx + j*n] = maps[dy*n+dx, i, j]
+    maps = maps.reshape(n_shift, n_shift, h16, w16)
+    dense = jnp.transpose(maps, (2, 0, 3, 1)).reshape(
+        h16 * n_shift, w16 * n_shift
+    )
+    return dense[:out_h, :out_w]
+
+
+def local_maxima_mask(score_map: jnp.ndarray, window: int):
+    """Device-side local-max detection matching scipy's
+    ``maximum_filter(size=w)`` footprint (autoPicker.py:80-86)."""
+    # scipy's centered window for size w spans [-w//2, w-1-w//2].
+    lo, hi = window // 2, window - 1 - window // 2
+    neg, pos = -jnp.inf, jnp.inf
+    padded_max = jnp.pad(score_map, ((lo, hi), (lo, hi)), constant_values=neg)
+    data_max = jax.lax.reduce_window(
+        padded_max, neg, jax.lax.max, (window, window), (1, 1), "VALID"
+    )
+    padded_min = jnp.pad(score_map, ((lo, hi), (lo, hi)), constant_values=pos)
+    data_min = jax.lax.reduce_window(
+        padded_min, pos, jax.lax.min, (window, window), (1, 1), "VALID"
+    )
+    return (score_map == data_max) & (data_max - data_min > 0)
+
+
+def peak_detection(score_map: np.ndarray, window: int):
+    """Local maxima + raster-order greedy suppression.
+
+    Mirrors the reference's semantics (autoPicker.py:62-131): plateau
+    maxima are merged by connected-component center of mass, then
+    candidate pairs closer than ``window / 2`` are resolved greedily
+    in raster order, keeping the higher score.
+
+    Returns:
+        ``(P, 3)`` float array of (x, y, score) on the score-map grid.
+    """
+    from scipy import ndimage
+
+    score_map = np.asarray(score_map)
+    maxima = np.asarray(
+        local_maxima_mask(jnp.asarray(score_map), window)
+    )
+    labeled, num = ndimage.label(maxima)
+    if num == 0:
+        return np.zeros((0, 3), np.float64)
+    yx = np.array(
+        ndimage.center_of_mass(score_map, labeled, range(1, num + 1))
+    ).astype(int)
+    scores = score_map[yx[:, 0], yx[:, 1]]
+
+    # Greedy raster-order suppression, O(P^2) pairwise like the
+    # reference but vectorized over the inner loop.
+    order = np.arange(len(yx))
+    dead = np.zeros(len(yx), bool)
+    thr = window / 2.0
+    for i in order[:-1]:
+        if dead[i]:
+            continue
+        rest = order[i + 1 :]
+        rest = rest[~dead[rest]]
+        if len(rest) == 0:
+            break
+        d = np.hypot(
+            yx[i, 0] - yx[rest, 0], yx[i, 1] - yx[rest, 1]
+        )
+        close = rest[d < thr]
+        if len(close) == 0:
+            continue
+        stronger = scores[close] > scores[i]
+        if stronger.any():
+            # The reference scans j ascending, killing weaker-or-equal
+            # neighbors until the first stronger one kills i.
+            cut = int(np.argmax(stronger))
+            dead[close[:cut]] = True
+            dead[i] = True
+        else:
+            dead[close] = True
+    keep = ~dead
+    return np.column_stack(
+        [yx[keep, 1], yx[keep, 0], scores[keep]]
+    ).astype(np.float64)
+
+
+def pick_micrograph(
+    params,
+    raw_img: np.ndarray,
+    particle_size: int,
+    *,
+    mode: str = "patch",
+    norm: str = "reference",
+    step: int = STEP_SIZE,
+):
+    """Full picking pass over one raw micrograph.
+
+    Returns ``(P, 3)`` of (x_center, y_center, score) in original
+    pixel coordinates, matching the reference's coordinate transform
+    ``(idx * step + patch/2) * bin`` (autoPicker.py:267-273).
+    """
+    img = pp.preprocess_micrograph(jnp.asarray(raw_img))
+    patch_size = int(particle_size / pp.BIN_SIZE)
+    window = int(0.6 * patch_size / step)
+    if mode == "fcn":
+        smap = score_micrograph_fcn(
+            fc_params_as_conv(params), img, patch_size=patch_size, step=step
+        )
+        # FCN scoring works on the rescaled grid; its effective step
+        # on the binned image is patch_size/64 * round(step*64/patch).
+        scale = PATCH_SIZE / patch_size
+        eff_step = max(1, int(round(step * scale))) / scale
+    else:
+        smap = score_micrograph_patches(
+            params, img, patch_size=patch_size, step=step, norm=norm
+        )
+        eff_step = step
+    peaks = peak_detection(np.asarray(smap), max(window, 1))
+    if len(peaks) == 0:
+        return peaks
+    coords = peaks.copy()
+    coords[:, 0] = (
+        coords[:, 0] * eff_step + patch_size / 2
+    ) * pp.BIN_SIZE
+    coords[:, 1] = (
+        coords[:, 1] * eff_step + patch_size / 2
+    ) * pp.BIN_SIZE
+    return coords

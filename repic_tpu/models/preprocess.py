@@ -1,0 +1,108 @@
+"""Micrograph / patch preprocessing for the CNN picker.
+
+Reproduces the reference DeepPicker preprocessing as fused jnp ops
+(reference: docs/patches/deeppicker/dataLoader.py:74-115 for the
+micrograph path; autoPicker.py:170-193 for the per-patch path):
+
+    micrograph: gaussian blur sigma=0.1 -> 3x3 mean-bin -> z-score
+    patch:      bytescale to uint8 -> bilinear resize to 64x64
+                -> per-patch z-score
+
+Everything is shape-static and jittable; the patch path is vmapped
+over the patch batch so one launch covers a whole micrograph's
+sliding-window grid.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BIN_SIZE = 3  # dataLoader.py:92 pooling_size
+GAUSSIAN_SIGMA = 0.1  # dataLoader.py:90
+
+
+def _gaussian_kernel1d(sigma: float, radius: int) -> np.ndarray:
+    # scipy.ndimage.gaussian_filter semantics: truncate=4.0 =>
+    # radius = int(4*sigma + 0.5); sigma=0.1 gives radius 0 (identity
+    # up to float noise), but keep the general path for other sigmas.
+    x = np.arange(-radius, radius + 1, dtype=np.float64)
+    k = np.exp(-0.5 * (x / sigma) ** 2)
+    return (k / k.sum()).astype(np.float32)
+
+
+def gaussian_blur(img: jnp.ndarray, sigma: float = GAUSSIAN_SIGMA):
+    """Separable Gaussian blur matching scipy's default truncation."""
+    radius = int(4.0 * sigma + 0.5)
+    if radius == 0:
+        return img
+    k = jnp.asarray(_gaussian_kernel1d(sigma, radius))
+    # scipy's default boundary mode 'reflect' repeats the edge
+    # sample — numpy/jnp call that 'symmetric'.
+    img = jnp.pad(img, ((radius, radius), (0, 0)), mode="symmetric")
+    img = jax.vmap(
+        lambda col: jnp.convolve(col, k, mode="valid"), in_axes=1, out_axes=1
+    )(img)
+    img = jnp.pad(img, ((0, 0), (radius, radius)), mode="symmetric")
+    return jax.vmap(lambda row: jnp.convolve(row, k, mode="valid"))(img)
+
+
+def bin2d(img: jnp.ndarray, factor: int = BIN_SIZE) -> jnp.ndarray:
+    """Mean-pool ``factor x factor`` blocks, cropping the remainder
+    (dataLoader.py bin_2d semantics)."""
+    h = (img.shape[0] // factor) * factor
+    w = (img.shape[1] // factor) * factor
+    img = img[:h, :w]
+    return img.reshape(
+        h // factor, factor, w // factor, factor
+    ).mean(axis=(1, 3))
+
+
+def preprocess_micrograph(img: jnp.ndarray) -> jnp.ndarray:
+    """Blur + bin + standardize (dataLoader.py:74-115).
+
+    Returns the binned, z-scored micrograph; the bin factor is the
+    module constant :data:`BIN_SIZE`.
+    """
+    img = gaussian_blur(img.astype(jnp.float32))
+    img = bin2d(img)
+    return (img - img.mean()) / img.std()
+
+
+def bytescale(patches: jnp.ndarray) -> jnp.ndarray:
+    """Per-patch min-max scale to rounded uint8 values in [0, 255].
+
+    Mirrors the deprecated ``scipy.misc.bytescale`` replication at
+    autoPicker.py:171-180 (including the +0.5 floor-round).
+    """
+    cmin = patches.min(axis=(-2, -1), keepdims=True)
+    cmax = patches.max(axis=(-2, -1), keepdims=True)
+    scale = jnp.where(cmax > cmin, cmax - cmin, 1.0)
+    b = (patches - cmin) * (255.0 / scale)
+    return jnp.floor(jnp.clip(b, 0, 255) + 0.5)
+
+
+def standardize_patches(patches: jnp.ndarray) -> jnp.ndarray:
+    """Per-patch z-score (autoPicker.py:188-190)."""
+    mean = patches.mean(axis=(-2, -1), keepdims=True)
+    std = patches.std(axis=(-2, -1), keepdims=True)
+    return (patches - mean) / jnp.where(std > 0, std, 1.0)
+
+
+def resize_patches(patches: jnp.ndarray, out_size: int) -> jnp.ndarray:
+    """Bilinear antialiased resize of ``(B, h, w)`` to ``(B, s, s)``
+    (torchvision F.resize with antialias, autoPicker.py:182-186)."""
+    return jax.image.resize(
+        patches,
+        (patches.shape[0], out_size, out_size),
+        method="linear",
+        antialias=True,
+    )
+
+
+def prepare_patches(patches: jnp.ndarray, out_size: int) -> jnp.ndarray:
+    """bytescale -> resize -> standardize, the full per-patch chain."""
+    return standardize_patches(
+        resize_patches(bytescale(patches), out_size)
+    )

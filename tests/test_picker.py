@@ -1,0 +1,250 @@
+"""CNN picker tests: preprocessing oracles, patch/FCN weight-sharing
+parity, peak detection vs a scipy oracle of the reference algorithm,
+checkpoint round-trip, and the pick CLI end-to-end."""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from repic_tpu.models import preprocess as pp
+from repic_tpu.models.cnn import (
+    PickerCNN,
+    PickerFCN,
+    fc_params_as_conv,
+)
+from repic_tpu.models import infer
+from repic_tpu.models.checkpoint import load_checkpoint, save_checkpoint
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = PickerCNN()
+    return model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 1))
+    )["params"]
+
+
+# ---------------------------------------------------------------- preprocess
+
+
+def test_bin2d_matches_numpy_oracle(rng):
+    img = rng.normal(size=(17, 23)).astype(np.float32)
+    got = np.asarray(pp.bin2d(jnp.asarray(img), 3))
+    want = np.zeros((5, 7), np.float32)
+    for i in range(5):
+        for j in range(7):
+            want[i, j] = img[3 * i : 3 * i + 3, 3 * j : 3 * j + 3].mean()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_gaussian_sigma01_is_identity(rng):
+    # scipy truncates at radius int(4*0.1+0.5)=0 => identity
+    img = rng.normal(size=(12, 12)).astype(np.float32)
+    got = np.asarray(pp.gaussian_blur(jnp.asarray(img), 0.1))
+    np.testing.assert_array_equal(got, img)
+
+
+def test_gaussian_larger_sigma_matches_scipy(rng):
+    scipy_ndimage = pytest.importorskip("scipy.ndimage")
+    img = rng.normal(size=(32, 40)).astype(np.float32)
+    got = np.asarray(pp.gaussian_blur(jnp.asarray(img), 1.5))
+    want = scipy_ndimage.gaussian_filter(img, 1.5, mode="reflect")
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_bytescale_oracle(rng):
+    patches = rng.normal(size=(5, 9, 9)).astype(np.float32) * 7
+    got = np.asarray(pp.bytescale(jnp.asarray(patches)))
+    for p, g in zip(patches, got):
+        cmin, cmax = p.min(), p.max()
+        want = np.floor(
+            np.clip((p - cmin) * (255.0 / (cmax - cmin)), 0, 255) + 0.5
+        )
+        np.testing.assert_allclose(g, want)
+    assert got.min() >= 0 and got.max() <= 255
+
+
+def test_standardize_patches(rng):
+    patches = rng.normal(size=(4, 8, 8)).astype(np.float32) * 3 + 5
+    got = np.asarray(pp.standardize_patches(jnp.asarray(patches)))
+    for g in got:
+        assert abs(g.mean()) < 1e-5
+        assert abs(g.std() - 1) < 1e-4
+
+
+def test_preprocess_micrograph_shapes(rng):
+    img = rng.normal(size=(100, 130)).astype(np.float32)
+    out = np.asarray(pp.preprocess_micrograph(jnp.asarray(img)))
+    assert out.shape == (33, 43)
+    assert abs(out.mean()) < 1e-5
+    assert abs(out.std() - 1) < 1e-4
+
+
+# ----------------------------------------------------------------- model
+
+
+def test_cnn_output_shape(params):
+    model = PickerCNN()
+    out = model.apply({"params": params}, jnp.zeros((7, 64, 64, 1)))
+    assert out.shape == (7, 2)
+
+
+def test_fcn_matches_patch_classifier(params, rng):
+    # Same weights, 64x64 input: FCN's single output == CNN logits.
+    x = jnp.asarray(rng.normal(size=(3, 64, 64, 1)).astype(np.float32))
+    cnn_logits = PickerCNN().apply({"params": params}, x)
+    fcn_logits = PickerFCN().apply(
+        {"params": fc_params_as_conv(params)}, x
+    )
+    np.testing.assert_allclose(
+        np.asarray(cnn_logits),
+        np.asarray(fcn_logits[:, 0, 0, :]),
+        atol=1e-5,
+    )
+
+
+def test_fcn_stride16_grid(params, rng):
+    # On a 96x96 input the FCN's (1,1) output equals the CNN applied
+    # to the window starting at (16,16).
+    x = jnp.asarray(rng.normal(size=(1, 96, 96, 1)).astype(np.float32))
+    fcn_logits = PickerFCN().apply(
+        {"params": fc_params_as_conv(params)}, x
+    )
+    assert fcn_logits.shape == (1, 3, 3, 2)
+    want = PickerCNN().apply({"params": params}, x[:, 16:80, 16:80, :])
+    np.testing.assert_allclose(
+        np.asarray(fcn_logits[:, 1, 1, :]), np.asarray(want), atol=1e-4
+    )
+
+
+# ------------------------------------------------------------- peaks
+
+
+def reference_peak_oracle(score_map, window):
+    """Literal scipy transcription of the reference peak detection
+    (autoPicker.py:62-131) used as the behavioral oracle."""
+    from scipy import ndimage
+    from scipy.ndimage import maximum_filter, minimum_filter
+
+    data_max = maximum_filter(score_map, window)
+    maxima = score_map == data_max
+    data_min = minimum_filter(score_map, window)
+    maxima[(data_max - data_min) <= 0] = False
+    labeled, num = ndimage.label(maxima)
+    yx = np.array(
+        ndimage.center_of_mass(score_map, labeled, range(1, num + 1))
+    ).astype(int)
+    items = [
+        [int(y), int(x), score_map[y, x], 0] for y, x in yx
+    ]
+    for i in range(len(items) - 1):
+        if items[i][3] == 1:
+            continue
+        for j in range(i + 1, len(items)):
+            if items[i][3] == 1:
+                break
+            if items[j][3] == 1:
+                continue
+            d = math.hypot(
+                items[i][0] - items[j][0], items[i][1] - items[j][1]
+            )
+            if d < window / 2:
+                if items[i][2] >= items[j][2]:
+                    items[j][3] = 1
+                else:
+                    items[i][3] = 1
+    return np.array(
+        [[it[1], it[0], it[2]] for it in items if it[3] == 0],
+        dtype=np.float64,
+    ).reshape(-1, 3)
+
+
+@pytest.mark.parametrize("window", [3, 5, 8, 9])
+def test_peak_detection_matches_reference_oracle(rng, window):
+    for _ in range(5):
+        smap = rng.random((40, 50))
+        got = infer.peak_detection(smap, window)
+        want = reference_peak_oracle(smap, window)
+        got_sorted = got[np.lexsort((got[:, 0], got[:, 1]))]
+        want_sorted = want[np.lexsort((want[:, 0], want[:, 1]))]
+        np.testing.assert_allclose(got_sorted, want_sorted)
+
+
+def test_peak_detection_constant_map():
+    assert len(infer.peak_detection(np.ones((20, 20)), 5)) == 0
+
+
+def test_peak_detection_single_peak():
+    smap = np.zeros((30, 30))
+    smap[12, 17] = 1.0
+    peaks = infer.peak_detection(smap, 5)
+    assert len(peaks) == 1
+    assert (peaks[0, 0], peaks[0, 1]) == (17, 12)
+
+
+# ---------------------------------------------------------- end-to-end
+
+
+def test_pick_micrograph_runs_both_modes(params, rng):
+    raw = rng.normal(size=(400, 430)).astype(np.float32)
+    for mode in ("patch", "fcn"):
+        coords = infer.pick_micrograph(
+            params, raw, particle_size=120, mode=mode
+        )
+        assert coords.shape[1] == 3
+        if len(coords):
+            # centers must lie inside the original micrograph
+            assert coords[:, 0].min() >= 0
+            assert coords[:, 0].max() <= 430
+            assert coords[:, 1].max() <= 400
+
+
+def test_checkpoint_roundtrip(params, tmp_path):
+    path = str(tmp_path / "model.rptpu")
+    meta = {"particle_size": 180, "patch_norm": "reference"}
+    save_checkpoint(path, params, meta)
+    params2, meta2 = load_checkpoint(path)
+    assert meta2 == meta
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        params,
+        params2,
+    )
+
+
+def test_checkpoint_bad_magic(tmp_path):
+    path = str(tmp_path / "junk.rptpu")
+    with open(path, "wb") as f:
+        f.write(b"not a checkpoint")
+    with pytest.raises(ValueError):
+        load_checkpoint(path)
+
+
+def test_pick_cli(params, tmp_path, rng):
+    from repic_tpu.main import main as cli_main
+    from repic_tpu.utils import mrc
+
+    mrc_dir = tmp_path / "mrcs"
+    out_dir = tmp_path / "out"
+    mrc_dir.mkdir()
+    for i in range(2):
+        mrc.write_mrc(
+            str(mrc_dir / f"mic{i}.mrc"),
+            rng.normal(size=(400, 400)).astype(np.float32),
+        )
+    ckpt = str(tmp_path / "model.rptpu")
+    save_checkpoint(
+        ckpt, params, {"particle_size": 120, "patch_norm": "reference"}
+    )
+    cli_main(
+        ["pick", ckpt, str(mrc_dir), str(out_dir), "--threshold", "0.0"]
+    )
+    boxes = sorted(os.listdir(out_dir))
+    assert boxes == ["mic0.box", "mic1.box"]
