@@ -1,0 +1,130 @@
+"""``fit`` subcommand — train the in-framework CNN picker.
+
+Capability-parity with the reference's DeepPicker training entry
+(reference: docs/patches/deeppicker/train.py:39-225 driven by
+fit_deep.sh:23-52): given micrographs plus BOX labels for a training
+and a validation split, train the patch classifier and save the
+best-validation checkpoint.  Warm-starting from a previous checkpoint
+(`--retrain_from`) covers the iterative-picking rounds, which retrain
+each round from the prior round's model (run.sh:271).
+
+Unlike the reference there is no BOX->STAR conversion hop or symlink
+farm (fit_deep.sh:23-32): labels are consumed directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+name = "fit"
+
+
+def add_arguments(parser) -> None:
+    parser.add_argument("train_mrc_dir", help="training micrographs (.mrc)")
+    parser.add_argument("train_label_dir", help="training labels (.box)")
+    parser.add_argument("model_out", help="output checkpoint path")
+    parser.add_argument(
+        "--val_mrc_dir",
+        default=None,
+        help="validation micrographs (default: train_mrc_dir)",
+    )
+    parser.add_argument(
+        "--val_label_dir",
+        required=True,
+        help="validation labels (.box) — the reference's explicit "
+        "validation directory (train.py:124-129)",
+    )
+    parser.add_argument("--particle_size", type=int, required=True)
+    parser.add_argument("--batch_size", type=int, default=128)
+    parser.add_argument("--max_epochs", type=int, default=200)
+    parser.add_argument(
+        "--patch_norm",
+        choices=["reference", "global"],
+        default="reference",
+        help="per-patch normalization chain; 'global' enables exact "
+        "fcn-mode picking",
+    )
+    parser.add_argument(
+        "--retrain_from",
+        default=None,
+        help="warm-start from an existing checkpoint",
+    )
+    parser.add_argument("--seed", type=int, default=1234)
+
+
+def main(args) -> None:
+    from repic_tpu.models.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+    from repic_tpu.models.data import load_dataset
+    from repic_tpu.models.train import TrainConfig, fit
+
+    try:
+        train_data, train_labels = load_dataset(
+            args.train_mrc_dir,
+            args.train_label_dir,
+            args.particle_size,
+            seed=args.seed,
+            patch_norm=args.patch_norm,
+        )
+        val_data, val_labels = load_dataset(
+            args.val_mrc_dir or args.train_mrc_dir,
+            args.val_label_dir,
+            args.particle_size,
+            seed=args.seed + 1,
+            patch_norm=args.patch_norm,
+        )
+    except (FileNotFoundError, ValueError) as e:
+        sys.exit(f"error: {e}")
+
+    print(
+        f"train: {len(train_data)} patches "
+        f"({int(train_labels.sum())} positive), "
+        f"val: {len(val_data)} patches"
+    )
+
+    init_params = None
+    if args.retrain_from:
+        init_params, prev_meta = load_checkpoint(args.retrain_from)
+        if prev_meta.get("patch_norm", "reference") != args.patch_norm:
+            sys.exit(
+                "error: --patch_norm differs from the warm-start "
+                f"checkpoint's ({prev_meta.get('patch_norm')!r})"
+            )
+
+    config = TrainConfig(
+        batch_size=args.batch_size,
+        max_epochs=args.max_epochs,
+        seed=args.seed,
+    )
+    result = fit(
+        train_data,
+        train_labels,
+        val_data,
+        val_labels,
+        config,
+        init_params=init_params,
+    )
+    save_checkpoint(
+        args.model_out,
+        result.params,
+        {
+            "particle_size": args.particle_size,
+            "patch_norm": args.patch_norm,
+            "best_val_error": result.best_val_error,
+            "epochs": result.epochs_run,
+            "seed": args.seed,
+        },
+    )
+    print(
+        f"saved {args.model_out} "
+        f"(best val error {result.best_val_error:.2f}%)"
+    )
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    add_arguments(parser)
+    main(parser.parse_args())

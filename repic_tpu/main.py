@@ -25,6 +25,7 @@ COMMANDS = {
     "consensus": "repic_tpu.commands.consensus",
     "iter_config": "repic_tpu.commands.iter_config",
     "pick": "repic_tpu.commands.pick",
+    "fit": "repic_tpu.commands.fit",
     "convert": "repic_tpu.utils.coords",
     "score": "repic_tpu.utils.scoring",
     "build_subsets": "repic_tpu.utils.subsets",

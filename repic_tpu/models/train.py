@@ -1,0 +1,218 @@
+"""Training loop for the in-framework CNN picker.
+
+Reproduces the reference DeepPicker training protocol (reference:
+docs/patches/deeppicker/train.py:39-225, deepModel.py:142-200) as one
+jitted update step driven by a host loop:
+
+* momentum SGD (0.9), lr 0.01 with staircase exponential decay x0.95
+  every 8 epochs' worth of steps (the REPIC-patched decay schedule,
+  train.py:167);
+* loss = softmax cross-entropy + L2(5e-4) on the FC weights only;
+* dropout 0.5 on the flattened features;
+* sequential batch offsets cycling the (pre-shuffled) training set,
+  per-epoch validation-error evaluation, best-checkpoint retention,
+  early stop after 32 epochs without improvement (train.py:185-225);
+* max 200 epochs.
+
+The update step is a single XLA program; on TPU each step is one
+MXU-resident fused forward/backward.  Validation batches are scored
+with the same jitted apply as picking.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from repic_tpu.models.cnn import PickerCNN, fc_l2_penalty
+
+
+@dataclass
+class TrainConfig:
+    batch_size: int = 128  # fit_deep.sh passes DEEP_BATCH_SIZE
+    learning_rate: float = 0.01
+    lr_decay_factor: float = 0.95
+    momentum: float = 0.9
+    max_epochs: int = 200
+    patience: int = 32  # train.py:186 toleration_patience
+    decay_epochs: int = 8  # train.py:167 REPIC_PATCH decay cadence
+    seed: int = 1234  # train.py:74-76 tf/np seeds
+    log_every: int = 1  # epochs between progress prints
+    verbose: bool = True
+
+
+@dataclass
+class TrainResult:
+    params: dict  # best-validation parameters
+    best_val_error: float
+    epochs_run: int
+    history: list = field(default_factory=list)
+
+
+def _make_update_step(model, tx):
+    @jax.jit
+    def update(params, opt_state, batch, labels, dropout_rng):
+        def loss_fn(p):
+            logits = model.apply(
+                {"params": p},
+                batch,
+                train=True,
+                rngs={"dropout": dropout_rng},
+            )
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            ).mean()
+            return ce + fc_l2_penalty(p), logits
+
+        (loss, logits), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, logits
+
+    return update
+
+
+def _make_eval_step(model):
+    @jax.jit
+    def logits_fn(params, batch):
+        return model.apply({"params": params}, batch)
+
+    return logits_fn
+
+
+def error_rate(logits: np.ndarray, labels: np.ndarray) -> float:
+    """Percent misclassified (train.py error_rate)."""
+    pred = np.argmax(logits, axis=1)
+    return 100.0 * float(np.mean(pred != labels))
+
+
+def evaluate(logits_fn, params, data, labels, batch_size=1024):
+    outs = []
+    for i in range(0, len(data), batch_size):
+        outs.append(
+            np.asarray(logits_fn(params, jnp.asarray(data[i : i + batch_size])))
+        )
+    return error_rate(np.concatenate(outs), labels)
+
+
+def fit(
+    train_data: np.ndarray,
+    train_labels: np.ndarray,
+    val_data: np.ndarray,
+    val_labels: np.ndarray,
+    config: TrainConfig = TrainConfig(),
+    *,
+    init_params=None,
+) -> TrainResult:
+    """Train a :class:`PickerCNN`, returning the best-val params.
+
+    ``init_params`` warm-starts from an existing checkpoint (the
+    reference's ``--model_retrain`` path, train.py:60-63 — each
+    iterative-picking round retrains from the previous round's model,
+    run.sh:271).
+    """
+    rng = np.random.default_rng(config.seed)
+    jrng = jax.random.PRNGKey(config.seed)
+
+    train_data, train_labels = _shuffle(train_data, train_labels, rng)
+    val_data, val_labels = _shuffle(val_data, val_labels, rng)
+
+    train_size = len(train_data)
+    batch_size = min(config.batch_size, train_size)
+    steps_per_epoch = max(train_size // batch_size, 1)
+    decay_steps = max(config.decay_epochs * steps_per_epoch, 1)
+
+    schedule = optax.exponential_decay(
+        config.learning_rate,
+        decay_steps,
+        config.lr_decay_factor,
+        staircase=True,
+    )
+    tx = optax.sgd(schedule, momentum=config.momentum)
+
+    model = PickerCNN()
+    if init_params is None:
+        jrng, init_rng = jax.random.split(jrng)
+        params = model.init(
+            init_rng, jnp.zeros((1,) + train_data.shape[1:])
+        )["params"]
+    else:
+        params = init_params
+
+    opt_state = tx.init(params)
+    update = _make_update_step(model, tx)
+    logits_fn = _make_eval_step(model)
+
+    best_val = float("inf")
+    best_params = params
+    patience = config.patience
+    history = []
+    t0 = time.time()
+    epochs_run = 0
+
+    max_steps = int(config.max_epochs * train_size) // batch_size
+    for step in range(max_steps):
+        offset = (step * batch_size) % max(train_size - batch_size, 1)
+        batch = jnp.asarray(train_data[offset : offset + batch_size])
+        labels = jnp.asarray(train_labels[offset : offset + batch_size])
+        jrng, drop_rng = jax.random.split(jrng)
+        params, opt_state, loss, logits = update(
+            params, opt_state, batch, labels, drop_rng
+        )
+
+        if step % steps_per_epoch == 0:
+            epochs_run = step // steps_per_epoch
+            val_err = evaluate(logits_fn, params, val_data, val_labels)
+            train_err = error_rate(
+                np.asarray(logits), np.asarray(labels)
+            )
+            history.append(
+                {
+                    "epoch": epochs_run,
+                    "loss": float(loss),
+                    "train_error": train_err,
+                    "val_error": val_err,
+                    "lr": float(schedule(step)),
+                }
+            )
+            if config.verbose and epochs_run % config.log_every == 0:
+                dt = time.time() - t0
+                print(
+                    f"epoch {epochs_run}: loss {float(loss):.4f} "
+                    f"train_err {train_err:.2f}% val_err {val_err:.2f}% "
+                    f"({dt:.1f}s)"
+                )
+            if val_err < best_val:
+                best_val = val_err
+                best_params = jax.tree_util.tree_map(
+                    np.asarray, params
+                )
+                patience = config.patience
+            else:
+                patience -= 1
+            if patience == 0:
+                if config.verbose:
+                    print(
+                        f"validation error has not improved in "
+                        f"{config.patience} epochs; stopping"
+                    )
+                break
+
+    return TrainResult(
+        params=best_params,
+        best_val_error=best_val,
+        epochs_run=epochs_run,
+        history=history,
+    )
+
+
+def _shuffle(data, labels, rng):
+    perm = rng.permutation(len(data))
+    return data[perm], labels[perm]
