@@ -62,11 +62,18 @@ def add_arguments(parser) -> None:
 
 def main(args) -> None:
     from repic_tpu.pipeline.iterative import run_iterative
+    from repic_tpu.pipeline.pickers import PickerError
 
     if not os.path.isfile(args.config_file):
         sys.exit(f"error: config file not found: {args.config_file}")
     with open(args.config_file) as f:
         config = json.load(f)
+    for key in ("data_dir", "box_size"):
+        if key not in config:
+            sys.exit(
+                f"error: config file missing required key {key!r} "
+                "(generate one with `repic-tpu iter_config`)"
+            )
 
     out_dir = args.out_dir or os.path.join(
         config["data_dir"], "iterative_picking"
@@ -82,7 +89,7 @@ def main(args) -> None:
             score_gt_dir=args.score,
             seed=args.seed,
         )
-    except (ValueError, FileNotFoundError) as e:
+    except (ValueError, FileNotFoundError, PickerError) as e:
         sys.exit(f"error: {e}")
 
 

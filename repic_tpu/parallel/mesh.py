@@ -10,7 +10,7 @@ multi-host pod the same code path shards over ICI+DCN via the global
 mesh — no explicit backend needed.
 """
 
-from functools import partial
+from functools import lru_cache
 
 import jax
 import numpy as np
@@ -19,10 +19,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 MICROGRAPH_AXIS = "micrographs"
 
 
+@lru_cache(maxsize=1)
+def _default_mesh() -> Mesh:
+    return Mesh(
+        np.asarray(jax.devices()).reshape(-1), (MICROGRAPH_AXIS,)
+    )
+
+
 def consensus_mesh(devices=None) -> Mesh:
-    """1-D mesh over all (or given) devices, micrograph-sharded."""
-    devices = np.asarray(devices if devices is not None else jax.devices())
-    return Mesh(devices.reshape(-1), (MICROGRAPH_AXIS,))
+    """1-D mesh over all (or given) devices, micrograph-sharded.
+
+    The default (all-devices) mesh is memoized so repeated callers get
+    an identical object — jit executable caches key on it.
+    """
+    if devices is None:
+        return _default_mesh()
+    return Mesh(np.asarray(devices).reshape(-1), (MICROGRAPH_AXIS,))
 
 
 def shard_over_micrographs(mesh: Mesh, *arrays):

@@ -14,7 +14,7 @@ available in :mod:`repic_tpu.commands` for drop-in parity.
 
 import os
 import time
-from functools import partial
+from functools import lru_cache, partial
 from typing import NamedTuple
 
 import jax
@@ -97,8 +97,17 @@ def make_batched_consensus(
     """Build the jitted batched consensus fn, sharded over micrographs.
 
     Returns ``fn(xy, conf, mask, box_size) -> ConsensusResult`` with a
-    leading micrograph axis on every in/out array.
+    leading micrograph axis on every in/out array.  Memoized on the
+    static configuration so repeated pipeline calls reuse one jit
+    wrapper (and therefore one compiled executable per input shape)
+    instead of re-tracing — compile time dwarfs execution for this
+    workload, so this cache IS the fast path.
     """
+    return _make_batched_consensus(threshold, max_neighbors, clique_capacity, mesh)
+
+
+@lru_cache(maxsize=64)
+def _make_batched_consensus(threshold, max_neighbors, clique_capacity, mesh):
     single = partial(
         consensus_one,
         threshold=threshold,
@@ -239,6 +248,8 @@ def run_consensus_dir(
         "micrographs": len(names),
         "skipped": skipped,
         "load_s": time.time() - t0,
+        "num_cliques": 0,
+        "particle_counts": {},
     }
     if not loaded:
         return stats

@@ -26,6 +26,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import shutil
 import time
 from dataclasses import dataclass, field
 
@@ -87,7 +88,14 @@ def build_splits(
         glob.glob(os.path.join(data_dir, "defocus*.t*"))
     )
     if defocus_files:
-        defocus = subsets_mod.parse_defocus_file(defocus_files[0])
+        # parse_defocus_file returns [(fname, mean_defocus)]; fname
+        # may or may not carry the .mrc extension, so key by stem
+        defocus = {
+            _stem(fname): d
+            for fname, d in subsets_mod.parse_defocus_file(
+                defocus_files[0]
+            )
+        }
         data = [
             (m, defocus.get(_stem(m), 0.0)) for m in mrcs
         ]
@@ -117,11 +125,16 @@ def build_splits(
         ("test", test_files),
     ):
         d = os.path.join(out_dir, "data", split)
-        os.makedirs(d, exist_ok=True)
+        # rebuild the symlink tree from scratch: stale links from a
+        # previous run with a different train_size/seed must not
+        # survive (same staleness semantics as run_consensus_dir's
+        # destructive out-dir handling)
+        if os.path.isdir(d):
+            shutil.rmtree(d)
+        os.makedirs(d)
         for f in files:
             link = os.path.join(d, os.path.basename(f))
-            if not os.path.exists(link):
-                os.symlink(os.path.abspath(f), link)
+            os.symlink(os.path.abspath(f), link)
         split_dirs[split] = d
     return split_dirs
 
@@ -182,6 +195,10 @@ def predict_round(
     pred_dirs = {}
     for split, mrc_dir in split_dirs.items():
         pdir = os.path.join(round_dir, "predictions", split)
+        # stale BOX files from a previous run with different splits
+        # must not leak into the consensus label set
+        if os.path.isdir(pdir):
+            shutil.rmtree(pdir)
         for picker in pickers:
             t0 = time.time()
             out = os.path.join(pdir, picker.name)
@@ -215,8 +232,8 @@ def consensus_round(
             use_mesh=False,
         )
         state.log(
-            f"consensus/{split}: {stats['num_cliques']} cliques over "
-            f"{stats['micrographs']} micrographs "
+            f"consensus/{split}: {stats.get('num_cliques', 0)} "
+            f"cliques over {stats['micrographs']} micrographs "
             f"({time.time() - t0:.1f}s)"
         )
         out[split] = cdir
@@ -312,16 +329,10 @@ def run_iterative(
             state,
             num_particles=exp_particles or None,
         )
-    state.balance = measure_balance(
-        consensus_dirs["train"], exp_particles
+    _finish_round(
+        state, pickers, consensus_dirs, round_dir,
+        exp_particles, score_gt_dir, "round_0",
     )
-    if state.balance is not None:
-        state.log(f"measured positive fraction: {state.balance:.4f}")
-        for p in pickers:
-            if hasattr(p, "balance"):
-                p.balance = state.balance
-    _score_stage(state, consensus_dirs, score_gt_dir, "round_0")
-    state.rounds.append({"dir": round_dir, "consensus": consensus_dirs})
 
     # ---- rounds 1..N: fit -> predict -> consensus
     for it in range(1, num_iter + 1):
@@ -353,19 +364,9 @@ def run_iterative(
             state,
             num_particles=exp_particles or None,
         )
-        state.balance = measure_balance(
-            consensus_dirs["train"], exp_particles
-        )
-        if state.balance is not None:
-            state.log(
-                f"round {it} positive fraction: {state.balance:.4f}"
-            )
-            for p in pickers:
-                if hasattr(p, "balance"):
-                    p.balance = state.balance
-        _score_stage(state, consensus_dirs, score_gt_dir, f"round_{it}")
-        state.rounds.append(
-            {"dir": round_dir, "consensus": consensus_dirs}
+        _finish_round(
+            state, pickers, consensus_dirs, round_dir,
+            exp_particles, score_gt_dir, f"round_{it}",
         )
 
     with open(os.path.join(out_dir, "state.json"), "wt") as f:
@@ -379,6 +380,26 @@ def run_iterative(
         )
     state.log("iterative picking complete")
     return state
+
+
+def _finish_round(
+    state, pickers, consensus_dirs, round_dir,
+    exp_particles, score_gt_dir, tag,
+):
+    """Post-consensus bookkeeping shared by round 0 and rounds 1..N:
+    measure the positive fraction (the reference's TOPAZ_BALANCE
+    export, run.sh:177,351), propagate it to balance-aware pickers,
+    score against ground truth, and record the round."""
+    state.balance = measure_balance(
+        consensus_dirs["train"], exp_particles
+    )
+    if state.balance is not None:
+        state.log(f"{tag} positive fraction: {state.balance:.4f}")
+        for p in pickers:
+            if hasattr(p, "balance"):
+                p.balance = state.balance
+    _score_stage(state, consensus_dirs, score_gt_dir, tag)
+    state.rounds.append({"dir": round_dir, "consensus": consensus_dirs})
 
 
 def _score_stage(state, consensus_dirs, gt_dir, tag):

@@ -162,6 +162,17 @@ class ExternalPicker:
     particle_size: int
     extra_env: dict = field(default_factory=dict)
 
+    def predict(self, mrc_dir, out_box_dir):
+        raise PickerError(
+            f"{self.name}: external picker execution requires a "
+            f"configured conda environment ({self.conda_env!r}); use a "
+            "subclass with command templates or set the env to "
+            "'builtin' for the in-framework JAX picker"
+        )
+
+    def fit(self, *a, **k):
+        raise PickerError(f"{self.name}: see predict()")
+
     def _run(self, cmd: list[str], log_path: str | None = None) -> None:
         if shutil.which("conda") is None:
             raise PickerError(
@@ -192,6 +203,38 @@ class CryoloPicker(ExternalPicker):
 
     model_path: str | None = None
 
+    def _write_config(self, path, work, train=None):
+        """crYOLO config JSON with the reference's LOWPASS filter at
+        cutoff 0.1 (run_cryolo.sh:22-27, fit_cryolo.sh:26-35)."""
+        import json
+
+        cfg = {
+            "model": {
+                "architecture": "PhosaurusNet",
+                "input_size": 1024,
+                "anchors": [self.particle_size, self.particle_size],
+                "max_box_per_image": 700,
+                "filter": [0.1, os.path.join(work, "filtered_tmp")],
+            }
+        }
+        if train:
+            train_mrc, train_box, val_mrc, val_box, model_out = train
+            cfg["train"] = {
+                "train_image_folder": train_mrc,
+                "train_annot_folder": train_box,
+                "train_times": 1,
+                "batch_size": 2,  # fit_cryolo.sh:38
+                "learning_rate": 1e-4,
+                "nb_epoch": 200,
+                "saved_weights_name": model_out,
+            }
+            cfg["valid"] = {
+                "valid_image_folder": val_mrc,
+                "valid_annot_folder": val_box,
+            }
+        with open(path, "wt") as f:
+            json.dump(cfg, f, indent=2)
+
     def predict_cmd(self, mrc_dir, out_dir, config_json):
         # run_cryolo.sh:22-36 — threshold 0.0, write empty outputs
         return [
@@ -205,8 +248,7 @@ class CryoloPicker(ExternalPicker):
         ]
 
     def fit_cmd(self, config_json):
-        # fit_cryolo.sh:26-44 — batch 2, early stop 32, warm restart,
-        # seed 1
+        # fit_cryolo.sh:26-44 — early stop 32, warm restart 5, seed 1
         return [
             "cryolo_train.py",
             "-c", config_json,
@@ -215,15 +257,108 @@ class CryoloPicker(ExternalPicker):
             "--seed", "1",
         ]
 
-    def predict(self, mrc_dir, out_box_dir):
-        raise PickerError(
-            "cryolo: external picker execution requires a configured "
-            "conda environment; command template available via "
-            "predict_cmd()"
+    def predict(self, mrc_dir, out_box_dir) -> int:
+        if not self.model_path:
+            raise PickerError("cryolo: no model weights configured")
+        os.makedirs(out_box_dir, exist_ok=True)
+        work = os.path.join(out_box_dir, "_cryolo_work")
+        os.makedirs(work, exist_ok=True)
+        config_json = os.path.join(work, "config.json")
+        self._write_config(config_json, work)
+        self._run(
+            self.predict_cmd(mrc_dir, work, config_json),
+            log_path=os.path.join(out_box_dir, "cryolo_predict.log"),
+        )
+        # crYOLO writes CBOX files under <out>/CBOX; convert to BOX
+        # (the reference pipes through coord_converter, run.sh:77)
+        return _convert_predictions_to_box(
+            os.path.join(work, "CBOX"), "cbox", out_box_dir,
+            self.particle_size, mrc_dir,
         )
 
-    def fit(self, *a, **k):
-        raise PickerError("cryolo: see predict()")
+    def fit(self, train_mrc, train_box, val_mrc, val_box, model_out):
+        work = os.path.dirname(os.path.abspath(model_out))
+        os.makedirs(work, exist_ok=True)
+        config_json = os.path.join(work, "cryolo_train_config.json")
+        self._write_config(
+            config_json, work,
+            train=(train_mrc, train_box, val_mrc, val_box, model_out),
+        )
+        self._run(
+            self.fit_cmd(config_json),
+            log_path=os.path.join(work, "cryolo_train.log"),
+        )
+        self.model_path = model_out
+
+
+@dataclass
+class DeepPickerExternal(ExternalPicker):
+    """DeepPicker adapter (reference run_deep.sh / fit_deep.sh)."""
+
+    deep_dir: str | None = None  # DeepPicker source checkout
+    model_path: str | None = None
+    batch_size: int = 1000
+
+    def predict_cmd(self, mrc_dir, out_dir):
+        # run_deep.sh:22-28 — patched autoPick.py at threshold 0.0
+        return [
+            "python",
+            os.path.join(self.deep_dir or ".", "autoPick.py"),
+            "--inputDir", mrc_dir,
+            "--pre_trained_model", self.model_path or "",
+            "--particle_size", str(self.particle_size),
+            "--outputDir", out_dir,
+            "--threshold", "0.0",
+        ]
+
+    def fit_cmd(self, train_dir, val_dir, model_out):
+        # fit_deep.sh:33-52 — retrain type-1 from the previous model
+        return [
+            "python",
+            os.path.join(self.deep_dir or ".", "train.py"),
+            "--train_type", "1",
+            "--train_inputDir", train_dir,
+            "--validation_inputDir", val_dir,
+            "--particle_size", str(self.particle_size),
+            "--model_retrain",
+            "--model_load_file", self.model_path or "",
+            "--model_save_file", model_out,
+            "--batch_size", str(self.batch_size),
+        ]
+
+    def predict(self, mrc_dir, out_box_dir) -> int:
+        if not self.deep_dir:
+            raise PickerError(
+                "deep: set deep_dir to the DeepPicker checkout "
+                "(iter_config --deep_dir)"
+            )
+        os.makedirs(out_box_dir, exist_ok=True)
+        work = os.path.join(out_box_dir, "_deep_work")
+        os.makedirs(work, exist_ok=True)
+        self._run(
+            self.predict_cmd(mrc_dir, work),
+            log_path=os.path.join(out_box_dir, "deep_predict.log"),
+        )
+        # autoPick writes one STAR per micrograph (autoPicker.py:278+)
+        return _convert_predictions_to_box(
+            work, "star", out_box_dir, self.particle_size, mrc_dir,
+        )
+
+    def fit(self, train_mrc, train_box, val_mrc, val_box, model_out):
+        # fit_deep.sh:23-32 — DeepPicker trains from STAR labels with
+        # the micrographs symlinked next to them
+        work = os.path.dirname(os.path.abspath(model_out))
+        train_dir = _stage_star_labels(
+            train_mrc, train_box, os.path.join(work, "deep_train")
+        )
+        val_dir = _stage_star_labels(
+            val_mrc, val_box, os.path.join(work, "deep_val")
+        )
+        self._run(
+            self.fit_cmd(train_dir, val_dir, model_out),
+            log_path=os.path.join(work, "deep_train.log"),
+        )
+        self.model_path = model_out
 
 
 @dataclass
@@ -235,12 +370,26 @@ class TopazPicker(ExternalPicker):
     model_path: str | None = None
     balance: float | None = None  # minibatch balance feedback
 
-    def predict_cmd(self, mrc_dir, out_file):
+    expected_particles: int = 0
+
+    def preprocess_cmd(self, mrc_dir, down_dir):
+        # preprocess_topaz.sh — downsample micrographs by TOPAZ_SCALE
+        return [
+            "topaz", "preprocess",
+            "-s", str(self.scale),
+            "-o", down_dir,
+        ] + sorted(
+            os.path.join(mrc_dir, f)
+            for f in os.listdir(mrc_dir)
+            if f.endswith(".mrc")
+        )
+
+    def predict_cmd(self, down_dir, out_file):
         # run_topaz.sh:19-36
         cmd = ["topaz", "extract", "-r", str(self.radius)]
         if self.model_path:
             cmd += ["-m", self.model_path]
-        cmd += ["-o", out_file, mrc_dir]
+        cmd += ["-o", out_file, os.path.join(down_dir, "*.mrc")]
         return cmd
 
     def fit_cmd(self, train_dir, targets, model_out, expected):
@@ -257,15 +406,183 @@ class TopazPicker(ExternalPicker):
             cmd += ["--minibatch-balance", f"{self.balance:.6f}"]
         return cmd
 
-    def predict(self, mrc_dir, out_box_dir):
-        raise PickerError(
-            "topaz: external picker execution requires a configured "
-            "conda environment; command template available via "
-            "predict_cmd()"
+    def predict(self, mrc_dir, out_box_dir) -> int:
+        os.makedirs(out_box_dir, exist_ok=True)
+        work = os.path.join(out_box_dir, "_topaz_work")
+        down = os.path.join(work, "down")
+        os.makedirs(down, exist_ok=True)
+        self._run(
+            self.preprocess_cmd(mrc_dir, down),
+            log_path=os.path.join(out_box_dir, "topaz_preprocess.log"),
+        )
+        out_tsv = os.path.join(work, "extracted.txt")
+        self._run(
+            self.predict_cmd(down, out_tsv),
+            log_path=os.path.join(out_box_dir, "topaz_extract.log"),
+        )
+        # split the single extraction table into per-micrograph BOX
+        # files, upscaling coordinates back by `scale` and creating
+        # empty placeholders (run_topaz.sh:40-48)
+        return _topaz_tsv_to_box(
+            out_tsv, out_box_dir, self.particle_size, self.scale,
+            mrc_dir,
         )
 
-    def fit(self, *a, **k):
-        raise PickerError("topaz: see predict()")
+    def fit(self, train_mrc, train_box, val_mrc, val_box, model_out):
+        work = os.path.dirname(os.path.abspath(model_out))
+        down = os.path.join(work, "topaz_train_down")
+        os.makedirs(down, exist_ok=True)
+        self._run(
+            self.preprocess_cmd(train_mrc, down),
+            log_path=os.path.join(work, "topaz_preprocess.log"),
+        )
+        targets = os.path.join(work, "topaz_targets.txt")
+        expected = _box_dir_to_topaz_tsv(
+            train_box, targets, self.particle_size, self.scale
+        )
+        self._run(
+            self.fit_cmd(
+                down, targets, model_out,
+                self.expected_particles or expected,
+            ),
+            log_path=os.path.join(work, "topaz_train.log"),
+        )
+        self.model_path = model_out
+
+
+def _convert_predictions_to_box(
+    pred_dir, in_fmt, out_box_dir, box_size, mrc_dir
+) -> int:
+    """Convert a directory of per-micrograph picker outputs (CBOX or
+    STAR) to BOX files, writing empty placeholders for micrographs
+    with no output (the reference pipes every picker through
+    coord_converter and backfills empties — run.sh:77,
+    run_topaz.sh:40-48)."""
+    import glob
+
+    from repic_tpu.utils import coords as coords_mod
+    from repic_tpu.utils.box_io import write_box, write_empty_box
+
+    paths = sorted(glob.glob(os.path.join(pred_dir, f"*.{in_fmt}")))
+    total = 0
+    produced = set()
+    if paths:
+        dfs = coords_mod.convert(
+            paths, in_fmt, "box", boxsize=box_size, quiet=True
+        )
+        for path, df in dfs.items():
+            stem = os.path.splitext(os.path.basename(path))[0]
+            produced.add(stem)
+            out = os.path.join(out_box_dir, stem + ".box")
+            if len(df) == 0:
+                write_empty_box(out)
+                continue
+            conf = (
+                df["conf"].to_numpy(float)
+                if "conf" in df.columns
+                else [1.0] * len(df)
+            )
+            write_box(
+                out, df[["x", "y"]].to_numpy(float), conf, box_size
+            )
+            total += len(df)
+    for mrc in sorted(glob.glob(os.path.join(mrc_dir, "*.mrc"))):
+        stem = os.path.splitext(os.path.basename(mrc))[0]
+        if stem not in produced:
+            write_empty_box(os.path.join(out_box_dir, stem + ".box"))
+    return total
+
+
+def _stage_star_labels(mrc_dir, box_dir, out_dir) -> str:
+    """DeepPicker training layout: STAR labels with the micrographs
+    symlinked next to them (reference fit_deep.sh:23-32)."""
+    import glob
+
+    from repic_tpu.utils import coords as coords_mod
+
+    os.makedirs(out_dir, exist_ok=True)
+    boxes = sorted(glob.glob(os.path.join(box_dir, "*.box")))
+    if boxes:
+        coords_mod.convert(
+            boxes, "box", "star", out_dir=out_dir, quiet=True,
+            force=True,
+        )
+    for mrc in sorted(glob.glob(os.path.join(mrc_dir, "*.mrc"))):
+        link = os.path.join(out_dir, os.path.basename(mrc))
+        if os.path.islink(link) or os.path.exists(link):
+            os.unlink(link)
+        os.symlink(os.path.abspath(mrc), link)
+    return out_dir
+
+
+def _topaz_tsv_to_box(
+    tsv_path, out_box_dir, box_size, scale, mrc_dir
+) -> int:
+    """Split a topaz extraction table (image_name x y score, on the
+    downsampled grid) into per-micrograph BOX files on the original
+    grid (reference run_topaz.sh:36-48: upscale by TOPAZ_SCALE, shift
+    center->corner, empty placeholders)."""
+    import glob
+
+    import numpy as np
+    import pandas as pd
+
+    from repic_tpu.utils.box_io import write_box, write_empty_box
+
+    os.makedirs(out_box_dir, exist_ok=True)
+    produced = set()
+    total = 0
+    if os.path.exists(tsv_path) and os.path.getsize(tsv_path) > 0:
+        df = pd.read_csv(tsv_path, sep="\t")
+        cols = {c.lower(): c for c in df.columns}
+        name_c = cols.get("image_name", df.columns[0])
+        for stem, grp in df.groupby(name_c):
+            stem = str(stem)
+            produced.add(stem)
+            xy = grp[[cols.get("x_coord", "x_coord"),
+                      cols.get("y_coord", "y_coord")]].to_numpy(float)
+            xy = xy * scale - box_size / 2.0
+            conf = (
+                grp[cols["score"]].to_numpy(float)
+                if "score" in cols
+                else np.ones(len(grp))
+            )
+            write_box(
+                os.path.join(out_box_dir, stem + ".box"),
+                xy, conf, box_size,
+            )
+            total += len(grp)
+    for mrc in sorted(glob.glob(os.path.join(mrc_dir, "*.mrc"))):
+        stem = os.path.splitext(os.path.basename(mrc))[0]
+        if stem not in produced:
+            write_empty_box(os.path.join(out_box_dir, stem + ".box"))
+    return total
+
+
+def _box_dir_to_topaz_tsv(box_dir, out_tsv, box_size, scale) -> int:
+    """BOX labels -> topaz training-target table on the downsampled
+    grid (reference fit_topaz.sh:23-31: corner->center, downscale).
+    Returns the mean particle count per micrograph (the expected-
+    particles input to fit_cmd)."""
+    import glob
+
+    from repic_tpu.utils.box_io import read_box
+
+    rows = []
+    files = sorted(glob.glob(os.path.join(box_dir, "*.box")))
+    for f in files:
+        stem = os.path.splitext(os.path.basename(f))[0]
+        bs = read_box(f)
+        for (x, y) in bs.xy:
+            cx = (float(x) + box_size / 2.0) / scale
+            cy = (float(y) + box_size / 2.0) / scale
+            rows.append((stem, int(round(cx)), int(round(cy))))
+    with open(out_tsv, "wt") as f:
+        f.write("image_name\tx_coord\ty_coord\n")
+        for stem, x, y in rows:
+            f.write(f"{stem}\t{x}\t{y}\n")
+    mean = int(round(len(rows) / max(len(files), 1)))
+    return max(mean, 1) if rows else 0
 
 
 def build_pickers(config: dict) -> list:
@@ -284,14 +601,17 @@ def build_pickers(config: dict) -> list:
     ]
     for i, (pname, env) in enumerate(specs):
         if env == "builtin":
-            model = None
-            # the cryolo_model slot doubles as the builtin initial
-            # checkpoint when it points at a .rptpu file
-            init = config.get(f"{pname}_model") or config.get(
-                "cryolo_model"
-            )
-            if pname == "cryolo" and init and init != "builtin":
-                model = init
+            # each builtin picker takes its own <name>_model slot;
+            # the cryolo_model slot doubles as a shared initial
+            # checkpoint for the whole builtin ensemble, but only
+            # when it is itself a repic-tpu checkpoint (in mixed
+            # configs it may be a SPHIRE-crYOLO .h5)
+            init = config.get(f"{pname}_model")
+            if not init:
+                shared = config.get("cryolo_model") or ""
+                if shared.endswith(".rptpu"):
+                    init = shared
+            model = init if init and init != "builtin" else None
             pickers.append(
                 BuiltinPicker(
                     name=pname,
@@ -321,10 +641,13 @@ def build_pickers(config: dict) -> list:
             )
         else:
             pickers.append(
-                ExternalPicker(
+                DeepPickerExternal(
                     name=pname,
                     conda_env=env,
                     particle_size=particle_size,
+                    deep_dir=config.get("deep_dir"),
+                    model_path=config.get("deep_model"),
+                    batch_size=int(config.get("deep_batch_size", 1000)),
                 )
             )
     return pickers

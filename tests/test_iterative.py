@@ -73,7 +73,8 @@ def test_seed_round0_sampling(dataset, tmp_path):
         assert read_box(f).n == 5  # 50% of 10
 
 
-def test_external_adapter_commands():
+def test_external_adapter_commands(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
     cry = pickers_mod.CryoloPicker(
         name="cryolo",
         conda_env="cryolo",
@@ -95,8 +96,113 @@ def test_external_adapter_commands():
     assert str(int(300 * 1.25)) in fit
     assert "--minibatch-balance" in fit
 
+    # predict wires through _run, which needs the conda env: absent
+    # here, so it must fail with a diagnosable PickerError (not an
+    # AttributeError), before and after writing its config
     with pytest.raises(pickers_mod.PickerError):
-        cry.predict("in", "out")
+        cry.predict(str(tmp_path / "in"), str(tmp_path / "out"))
+
+    # the generic base adapter also raises PickerError, not
+    # AttributeError
+    base = pickers_mod.ExternalPicker(
+        name="x", conda_env="nope", particle_size=180
+    )
+    with pytest.raises(pickers_mod.PickerError):
+        base.predict("in", "out")
+    with pytest.raises(pickers_mod.PickerError):
+        base.fit()
+
+
+def test_build_splits_defocus_file(dataset, tmp_path):
+    """A defocus table routes through the stratified splitter."""
+    data_dir, _ = dataset
+    defocus = os.path.join(data_dir, "defocus.txt")
+    rng = np.random.default_rng(3)
+    with open(defocus, "wt") as f:
+        for i in range(8):
+            d = 10000 + 1000 * float(rng.uniform())
+            f.write(f"mic{i}.mrc\t{d:.1f}\t{d + 50:.1f}\n")
+    try:
+        dirs = iterative.build_splits(data_dir, str(tmp_path))
+        all_links = sorted(
+            l for d in dirs.values() for l in os.listdir(d)
+        )
+        assert len(all_links) == 8 and len(set(all_links)) == 8
+    finally:
+        os.remove(defocus)
+
+
+def test_build_splits_reset_on_rerun(dataset, tmp_path):
+    """Re-running with a smaller train_size must not keep stale
+    symlinks from the previous run."""
+    data_dir, _ = dataset
+    dirs = iterative.build_splits(data_dir, str(tmp_path))
+    assert len(os.listdir(dirs["train"])) == 2
+    dirs = iterative.build_splits(
+        data_dir, str(tmp_path), train_size=50
+    )
+    assert len(os.listdir(dirs["train"])) == 1
+
+
+def test_consensus_round_empty_split(tmp_path):
+    """A split with zero loadable micrographs must not crash."""
+    pdir = tmp_path / "pred"
+    for picker in ("p1", "p2"):
+        (pdir / picker).mkdir(parents=True)
+    state = iterative.IterativeState(out_dir=str(tmp_path))
+    out = iterative.consensus_round(
+        {"train": str(pdir)}, str(tmp_path / "r"), 180, state
+    )
+    assert "train" in out
+
+
+def test_topaz_tsv_box_roundtrip(tmp_path):
+    """Extraction-table coordinates upscale back to the original grid
+    and the BOX labels downscale back to the extraction grid."""
+    mrc = tmp_path / "mrc"
+    mrc.mkdir()
+    (mrc / "a.mrc").write_bytes(b"")
+    (mrc / "b.mrc").write_bytes(b"")
+    tsv = tmp_path / "ex.txt"
+    tsv.write_text(
+        "image_name\tx_coord\ty_coord\tscore\n"
+        "a\t100\t200\t0.9\n"
+    )
+    n = pickers_mod._topaz_tsv_to_box(
+        str(tsv), str(tmp_path / "out"), 64, 4, str(mrc)
+    )
+    assert n == 1
+    # empty placeholder for the micrograph topaz found nothing in
+    assert (tmp_path / "out" / "b.box").exists()
+    from repic_tpu.utils.box_io import read_box
+
+    bs = read_box(str(tmp_path / "out" / "a.box"))
+    assert tuple(bs.xy[0]) == (100 * 4 - 32, 200 * 4 - 32)
+
+    back = pickers_mod._box_dir_to_topaz_tsv(
+        str(tmp_path / "out"), str(tmp_path / "back.txt"), 64, 4
+    )
+    lines = (tmp_path / "back.txt").read_text().splitlines()
+    assert lines[1] == "a\t100\t200"
+    assert back == 1  # mean 0.5 over two micrographs, floored at 1
+
+
+def test_build_pickers_shared_checkpoint_fallback():
+    """cryolo_model is shared with builtin deep/topaz only when it is
+    itself a repic-tpu checkpoint."""
+    base = {"box_size": 180}
+    ps = pickers_mod.build_pickers(
+        dict(base, cryolo_model="init.rptpu")
+    )
+    assert [p.model_path for p in ps] == ["init.rptpu"] * 3
+    # a SPHIRE-crYOLO .h5 must NOT leak into the builtin pickers
+    ps = pickers_mod.build_pickers(dict(base, cryolo_model="g.h5"))
+    assert [p.model_path for p in ps] == ["g.h5", None, None]
+    # per-picker slots always win
+    ps = pickers_mod.build_pickers(
+        dict(base, cryolo_model="init.rptpu", deep_model="d.rptpu")
+    )
+    assert ps[1].model_path == "d.rptpu"
 
 
 def test_builtin_picker_requires_model(tmp_path):
