@@ -35,20 +35,45 @@ def add_arguments(parser):
     parser.add_argument(
         "--no_mesh", action="store_true", help="disable device-mesh sharding"
     )
+    parser.add_argument(
+        "--spatial",
+        choices=["auto", "on", "off"],
+        default="auto",
+        help="bucketed neighbor search for dense micrographs "
+        "(auto: by particle count)",
+    )
+    parser.add_argument(
+        "--profile",
+        metavar="DIR",
+        help="write a jax.profiler device trace to DIR "
+        "(view with TensorBoard/Perfetto)",
+    )
+    parser.add_argument(
+        "--solver",
+        choices=["greedy", "lp"],
+        default="greedy",
+        help="packing backend: parallel greedy dominance, or LP "
+        "relaxation + rounding (never worse than greedy)",
+    )
 
 
 def main(args):
     from repic_tpu.pipeline.consensus import run_consensus_dir
+    from repic_tpu.utils.tracing import trace_session
 
-    stats = run_consensus_dir(
-        args.in_dir,
-        args.out_dir,
-        args.box_size,
-        threshold=args.threshold,
-        max_neighbors=args.max_neighbors,
-        num_particles=args.num_particles,
-        use_mesh=not args.no_mesh,
-    )
+    spatial = {"auto": None, "on": True, "off": False}[args.spatial]
+    with trace_session(args.profile):
+        stats = run_consensus_dir(
+            args.in_dir,
+            args.out_dir,
+            args.box_size,
+            threshold=args.threshold,
+            max_neighbors=args.max_neighbors,
+            num_particles=args.num_particles,
+            use_mesh=not args.no_mesh,
+            spatial=spatial,
+            solver=args.solver,
+        )
     print(json.dumps(stats, default=str, indent=2))
 
 

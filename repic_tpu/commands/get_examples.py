@@ -1,0 +1,85 @@
+"""``get_examples`` subcommand — fetch the EMPIAR-10057 example set.
+
+Parity with the reference's Bash fetcher (reference:
+repic/iterative_particle_picking/get_examples.sh): downloads 32 T20S
+proteasome micrographs plus normative particle BOX files from the
+REPIC public S3 bucket, for use with ``iter_pick``.  Implemented with
+urllib (no wget/curl dependency), resumable (existing complete files
+are skipped), and degrades with a clear message in offline
+environments.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import urllib.error
+import urllib.request
+
+name = "get_examples"
+
+BUCKET = "http://org.gersteinlab.repic.s3.amazonaws.com/example_data_10057"
+
+# 32 EMPIAR-10057 micrograph stems (get_examples.sh:24)
+FILE_STEMS = (
+    "Jul21_17_36_51 Jul21_17_39_03 Jul21_17_52_20 Jul21_17_56_42 "
+    "Jul21_18_05_31 Jul21_18_38_48 Jul21_19_35_51 Jul21_19_38_03 "
+    "Jul21_19_54_12 Jul21_19_56_25 Jul21_20_23_38 Jul21_20_39_19 "
+    "Jul21_20_45_56 Jul21_20_50_20 Jul21_20_57_21 Jul21_21_24_01 "
+    "Jul21_21_57_27 Jul21_22_04_08 Jul21_22_15_09 Jul21_22_37_22 "
+    "Jul21_23_02_48 Jul21_23_05_02 Jul21_23_13_57 Jul21_23_16_09 "
+    "Jul21_23_22_39 Jul21_23_24_50 Jul22_00_07_03 Jul22_00_13_45 "
+    "Jul22_00_35_04 Jul22_00_37_23 Jul22_00_41_50 Jul22_00_52_53"
+).split()
+
+
+def add_arguments(parser) -> None:
+    parser.add_argument(
+        "out_dir", help="output directory (created if missing)"
+    )
+    parser.add_argument(
+        "--timeout", type=float, default=60.0,
+        help="per-file download timeout (seconds)",
+    )
+
+
+def _fetch(url: str, dst: str, timeout: float) -> int:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        data = r.read()
+    tmp = dst + ".part"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, dst)
+    return len(data)
+
+
+def main(args) -> None:
+    os.makedirs(args.out_dir, exist_ok=True)
+    done = skipped = 0
+    for stem in FILE_STEMS:
+        for ext in (".mrc", ".box"):
+            dst = os.path.join(args.out_dir, stem + ext)
+            if os.path.exists(dst) and os.path.getsize(dst) > 0:
+                skipped += 1
+                continue
+            url = f"{BUCKET}/{stem}{ext}"
+            try:
+                nbytes = _fetch(url, dst, args.timeout)
+            except (urllib.error.URLError, OSError) as e:
+                sys.exit(
+                    f"error: download failed for {url}: {e}\n"
+                    "(this environment may have no network access — "
+                    "fetch the EMPIAR-10057 example set from the "
+                    "REPIC S3 bucket on a connected machine and copy "
+                    f"it into {args.out_dir})"
+                )
+            done += 1
+            print(f"{stem}{ext}\t{nbytes} bytes")
+    print(f"downloaded {done} files, skipped {skipped} existing")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    add_arguments(parser)
+    main(parser.parse_args())

@@ -30,6 +30,7 @@ COMMANDS = {
     "convert": "repic_tpu.utils.coords",
     "score": "repic_tpu.utils.scoring",
     "build_subsets": "repic_tpu.utils.subsets",
+    "get_examples": "repic_tpu.commands.get_examples",
 }
 
 

@@ -43,7 +43,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from repic_tpu.ops.iou import pairwise_iou_matrix
+from repic_tpu.ops.iou import pair_iou_xy, pairwise_iou_matrix
 
 DEFAULT_THRESHOLD = 0.3  # reference: get_cliques.py:138
 
@@ -62,6 +62,8 @@ class CliqueSet(NamedTuple):
     rep_slot: jax.Array     # (C,) int32 — picker slot of representative
     rep_xy: jax.Array       # (C, 2) float — representative coordinates
     max_adjacency: jax.Array  # () int32 — neighbor-list overflow probe
+    max_cell_count: jax.Array  # () int32 — bucket overflow probe (0 = dense path)
+    num_valid: jax.Array    # () int32 — valid cliques BEFORE any compaction
 
     @property
     def capacity(self) -> int:
@@ -74,6 +76,16 @@ class CliqueSet(NamedTuple):
 
 def _edge_pairs(k: int):
     return list(itertools.combinations(range(k), 2))
+
+
+def _per_picker_sizes(box_size, k: int, dtype) -> jax.Array:
+    """Normalize a scalar or per-picker box size to a ``(K,)`` array.
+
+    The reference supports a single box size only; per-picker sizes
+    are the mixed-ensemble extension (IoU uses
+    ``inter / (sa^2 + sb^2 - inter)``, which reduces to the
+    reference's formula when equal)."""
+    return jnp.broadcast_to(jnp.asarray(box_size, dtype).reshape(-1), (k,))
 
 
 def enumerate_cliques(
@@ -104,28 +116,128 @@ def enumerate_cliques(
             f"clique enumeration needs at least 2 pickers, got K={K}"
         )
     D = min(max_neighbors, N)
-    dtype = xy.dtype
+    sizes = _per_picker_sizes(box_size, K, xy.dtype)
 
-    # Pairwise masked IoU matrices for every picker pair (static K).
-    iou = {}
-    for p, q in _edge_pairs(K):
-        iou[(p, q)] = pairwise_iou_matrix(
-            xy[p], mask[p], xy[q], mask[q], box_size
-        )
-
-    # Overflow probe: the enumeration is complete iff every anchor's
-    # above-threshold neighbor count fits in D for every pair (0, p).
-    adj_counts = [
-        jnp.sum(iou[(0, p)] > threshold, axis=1) for p in range(1, K)
-    ]
-    max_adjacency = jnp.max(jnp.stack(adj_counts)).astype(jnp.int32)
-
-    # Top-D neighbor lists of each anchor particle in every other picker.
-    nbr_idx, nbr_iou = [], []
+    # Pairwise masked IoU matrices for the anchor pairs (0, p) only;
+    # cross edges are validated elementwise from coordinates later.
+    nbr_idx, nbr_iou, adj_counts = [], [], []
     for p in range(1, K):
-        v, i = jax.lax.top_k(iou[(0, p)], D)  # (N, D)
+        iou_0p = pairwise_iou_matrix(
+            xy[0], mask[0], xy[p], mask[p], sizes[0], sizes[p]
+        )
+        # Overflow probe: the enumeration is complete iff every
+        # anchor's above-threshold neighbor count fits in D.
+        adj_counts.append(jnp.sum(iou_0p > threshold, axis=1))
+        v, i = jax.lax.top_k(iou_0p, D)  # (N, D)
         nbr_iou.append(v)
         nbr_idx.append(i)
+    max_adjacency = jnp.max(jnp.stack(adj_counts)).astype(jnp.int32)
+
+    return _assemble_cliques(
+        xy, conf, mask, box_size, threshold,
+        nbr_idx, nbr_iou, max_adjacency, jnp.int32(0),
+    )
+
+
+def enumerate_cliques_bucketed(
+    xy: jax.Array,
+    conf: jax.Array,
+    mask: jax.Array,
+    box_size,
+    *,
+    threshold: float = DEFAULT_THRESHOLD,
+    max_neighbors: int = 16,
+    grid: int = 32,
+    cell_capacity: int = 64,
+    clique_capacity: int | None = None,
+    anchor_chunk: int = 4096,
+) -> CliqueSet:
+    """Memory-bounded clique enumeration for dense micrographs.
+
+    Identical semantics to :func:`enumerate_cliques` but neighbor
+    candidates come from a ``box_size``-wide spatial hash (3x3 cell
+    gathers, :mod:`repic_tpu.ops.spatial`) instead of dense ``(N, N)``
+    IoU matrices — O(N * 9 * cell_capacity) memory, which is what
+    makes 50k-particle dense-field micrographs tractable.  Per-cell
+    overflow is reported via ``max_cell_count`` (complete iff
+    ``<= cell_capacity``); callers escalate exactly like they do for
+    ``max_adjacency``.
+    """
+    from repic_tpu.ops.spatial import (
+        bucket_particles,
+        bucketed_topk_neighbors,
+    )
+
+    K, N, _ = xy.shape
+    if K < 2:
+        raise ValueError(
+            f"clique enumeration needs at least 2 pickers, got K={K}"
+        )
+    D = min(max_neighbors, N)
+    sizes = _per_picker_sizes(box_size, K, xy.dtype)
+    # Hash with the LARGEST box size as the cell width: two boxes of
+    # sizes sa, sb overlap only if their corners differ by less than
+    # max(sa, sb) per axis, so the 3x3 neighborhood stays complete
+    # for mixed-size ensembles.
+    cell_size = jnp.max(sizes)
+
+    bts = [
+        bucket_particles(
+            xy[p], mask[p], cell_size,
+            grid=grid, cell_capacity=cell_capacity,
+        )
+        for p in range(K)
+    ]
+    max_cell_count = jnp.max(
+        jnp.stack([bt.max_count for bt in bts])
+    ).astype(jnp.int32)
+
+    nbr_idx, nbr_iou, adj_counts = [], [], []
+    for p in range(1, K):
+        v, i, adj = bucketed_topk_neighbors(
+            xy[0], mask[0], bts[0], xy[p], mask[p], bts[p],
+            sizes[0], sizes[p],
+            threshold=threshold, d=D,
+        )
+        adj_counts.append(adj)
+        nbr_iou.append(v)
+        nbr_idx.append(i)
+    max_adjacency = jnp.max(jnp.stack(adj_counts)).astype(jnp.int32)
+
+    if clique_capacity is not None and N > anchor_chunk:
+        return _assemble_cliques_chunked(
+            xy, conf, mask, box_size, threshold,
+            nbr_idx, nbr_iou, max_adjacency, max_cell_count,
+            clique_capacity, anchor_chunk,
+        )
+    return _assemble_cliques(
+        xy, conf, mask, box_size, threshold,
+        nbr_idx, nbr_iou, max_adjacency, max_cell_count,
+    )
+
+
+def _assemble_block(
+    xy, conf, mask, box_size, threshold,
+    anchor_ids, anchor_mask, nbr_idx, nbr_iou,
+):
+    """Cartesian product of per-anchor neighbor lists, elementwise
+    cross-edge validation from coordinates, and per-clique statistics
+    for one block of anchors.
+
+    Args:
+        anchor_ids: ``(A,)`` int32 — picker-0 particle indices of this
+            block (the full enumeration uses ``arange(N)``).
+        anchor_mask: ``(A,)`` — validity of each anchor.
+        nbr_idx/nbr_iou: K-1 arrays of ``(A, D)`` neighbor indices /
+            IoUs; indices may contain the sentinel ``N`` (no
+            candidate) — such tuples are masked invalid.
+
+    Returns a dict of ``(A*Dprod, ...)`` clique arrays.
+    """
+    K, N, _ = xy.shape
+    A = anchor_ids.shape[0]
+    D = nbr_idx[0].shape[1]
+    dtype = xy.dtype
 
     # Cartesian product over the K-1 neighbor slots.
     grids = jnp.meshgrid(*([jnp.arange(D)] * (K - 1)), indexing="ij")
@@ -133,25 +245,48 @@ def enumerate_cliques(
     dprod = D ** (K - 1)
 
     # Member particle indices per slot: anchor + K-1 neighbors.
-    anchor = jnp.broadcast_to(jnp.arange(N)[:, None], (N, dprod))
+    anchor = jnp.broadcast_to(anchor_ids[:, None], (A, dprod))
     members = [anchor] + [nbr_idx[s][:, sel[s]] for s in range(K - 1)]
+    member_ok = anchor_mask[:, None]
+    members_safe = [anchor]
+    for s in range(K - 1):
+        m = members[s + 1]
+        in_range = m < N
+        safe = jnp.where(in_range, m, 0)
+        member_ok = member_ok & in_range & jnp.where(
+            in_range, mask[s + 1][safe], False
+        )
+        members_safe.append(safe)
 
-    # Edge IoUs for every pair of the clique, in combinations order.
+    # Edge IoUs for every pair of the clique, in combinations order:
+    # anchor pairs reuse the top-k values; cross pairs are validated
+    # elementwise from coordinates (no pairwise matrix needed).
+    # Coordinates are gathered as separate x/y scalar arrays: a
+    # gather producing a trailing dim-2 axis gets tile-padded 2->128
+    # on TPU — a 64x memory blowup at 50k-particle scale.
+    xs, ys = xy[..., 0], xy[..., 1]               # (K, N) each
+    sizes = _per_picker_sizes(box_size, K, dtype)
+    mx = [xs[p][members_safe[p]] for p in range(K)]
+    my = [ys[p][members_safe[p]] for p in range(K)]
     edge_vals = []
     for p, q in _edge_pairs(K):
         if p == 0:
             edge_vals.append(nbr_iou[q - 1][:, sel[q - 1]])
         else:
-            edge_vals.append(iou[(p, q)][members[p], members[q]])
-    edges = jnp.stack(edge_vals)                  # (E, N, Dprod)
+            e = pair_iou_xy(
+                mx[p], my[p], mx[q], my[q], sizes[p], sizes[q]
+            )
+            edge_vals.append(jnp.where(member_ok, e, 0.0))
+    edges = jnp.stack(edge_vals)                  # (E, A, Dprod)
 
-    valid = mask[0][:, None] & jnp.all(edges > threshold, axis=0)
+    valid = member_ok & jnp.all(edges > threshold, axis=0)
+    members = members_safe
 
     # Member confidences, clique confidence, ILP weight.
     confs = jnp.stack(
-        [jnp.broadcast_to(conf[0][:, None], (N, dprod))]
+        [jnp.broadcast_to(conf[0][anchor_ids][:, None], (A, dprod))]
         + [conf[p + 1][members[p + 1]] for p in range(K - 1)]
-    )                                             # (K, N, Dprod)
+    )                                             # (K, A, Dprod)
     confidence = jnp.median(confs, axis=0)
     edge_med = jnp.median(edges, axis=0)
     w = jnp.where(valid, confidence * edge_med, 0.0).astype(dtype)
@@ -166,25 +301,134 @@ def enumerate_cliques(
             if p == k_slot or q == k_slot
         ]
         degs.append(sum(incident))
-    deg = jnp.stack(degs)                         # (K, N, Dprod)
-    rep_slot = jnp.argmax(deg, axis=0).astype(jnp.int32)  # (N, Dprod)
+    deg = jnp.stack(degs)                         # (K, A, Dprod)
+    rep_slot = jnp.argmax(deg, axis=0).astype(jnp.int32)  # (A, Dprod)
 
-    member_idx = jnp.stack(members, axis=-1)      # (N, Dprod, K)
+    member_idx = jnp.stack(members, axis=-1)      # (A, Dprod, K)
     rep_particle = jnp.take_along_axis(
         member_idx, rep_slot[..., None], axis=-1
-    ).squeeze(-1)                                 # (N, Dprod)
-    rep_xy = xy[rep_slot, rep_particle]           # (N, Dprod, 2)
+    ).squeeze(-1)                                 # (A, Dprod)
+    rep_x = xs[rep_slot, rep_particle]            # (A, Dprod)
+    rep_y = ys[rep_slot, rep_particle]
+    rep_xy = jnp.stack([rep_x, rep_y], axis=-1)   # (A, Dprod, 2)
 
-    c = N * dprod
-    return CliqueSet(
+    c = A * dprod
+    return dict(
         member_idx=member_idx.reshape(c, K).astype(jnp.int32),
         valid=valid.reshape(c),
         w=w.reshape(c),
         confidence=confidence.reshape(c),
         rep_slot=rep_slot.reshape(c),
         rep_xy=rep_xy.reshape(c, 2),
-        max_adjacency=max_adjacency,
     )
+
+
+def _assemble_cliques(
+    xy, conf, mask, box_size, threshold,
+    nbr_idx, nbr_iou, max_adjacency, max_cell_count,
+) -> CliqueSet:
+    """Full-anchor clique assembly (all anchors in one block)."""
+    N = xy.shape[1]
+    block = _assemble_block(
+        xy, conf, mask, box_size, threshold,
+        jnp.arange(N, dtype=jnp.int32), mask[0], nbr_idx, nbr_iou,
+    )
+    return CliqueSet(
+        max_adjacency=max_adjacency,
+        max_cell_count=max_cell_count,
+        num_valid=jnp.sum(block["valid"]).astype(jnp.int32),
+        **block,
+    )
+
+
+def _assemble_cliques_chunked(
+    xy, conf, mask, box_size, threshold,
+    nbr_idx, nbr_iou, max_adjacency, max_cell_count,
+    clique_capacity, anchor_chunk,
+) -> CliqueSet:
+    """Anchor-chunked clique assembly with per-chunk compaction.
+
+    The ``(E, N, Dprod)`` edge tensors of the full assembly dominate
+    memory at stress scale; chunking anchors through ``lax.map``
+    bounds the transient to ``(E, A, Dprod)`` while per-chunk stream
+    compaction bounds the retained cliques to ``clique_capacity``
+    rows per chunk.  Compaction is by index (cumsum + scatter), not
+    by weight: sorting millions of candidates per chunk is what the
+    capacity-escalation contract makes unnecessary — whenever the
+    total valid count exceeds ``clique_capacity`` the caller re-runs
+    with a larger capacity (``num_valid`` preserves the true count),
+    so at the accepted configuration nothing is ever dropped.
+    """
+    K, N, _ = xy.shape
+    a = min(anchor_chunk, N)
+    if N % a:
+        a = N
+    nc = N // a
+    D = nbr_idx[0].shape[1]
+    keep = min(clique_capacity, a * D ** (K - 1))
+
+    def one(args):
+        aid, amask, nidx, niou = args
+        block = _assemble_block(
+            xy, conf, mask, box_size, threshold,
+            aid, amask, list(nidx), list(niou),
+        )
+        out = _stream_compact(block, keep)
+        out["nvalid"] = jnp.sum(block["valid"]).astype(jnp.int32)
+        return out
+
+    res = jax.lax.map(
+        one,
+        (
+            jnp.arange(N, dtype=jnp.int32).reshape(nc, a),
+            mask[0].reshape(nc, a),
+            tuple(x.reshape(nc, a, D) for x in nbr_idx),
+            tuple(x.reshape(nc, a, D) for x in nbr_iou),
+        ),
+    )
+    num_valid = jnp.sum(res.pop("nvalid")).astype(jnp.int32)
+    # Merge the per-chunk buffers and compact once more to the final
+    # capacity (again index-ordered; escalation covers overflow).
+    merged = {
+        k2: v.reshape((nc * keep,) + v.shape[2:]) for k2, v in res.items()
+    }
+    final = _stream_compact(merged, clique_capacity)
+    return CliqueSet(
+        member_idx=final["member_idx"],
+        valid=final["valid"],
+        w=final["w"],
+        confidence=final["confidence"],
+        rep_slot=final["rep_slot"],
+        rep_xy=final["rep_xy"],
+        max_adjacency=max_adjacency,
+        max_cell_count=max_cell_count,
+        num_valid=num_valid,
+    )
+
+
+def _stream_compact(block: dict, keep: int) -> dict:
+    """Pack the valid rows of a clique block into the first ``keep``
+    slots (index order preserved; rows past ``keep`` are dropped —
+    callers detect that via the separately-tracked valid count).
+
+    O(n) cumsum + scatter instead of an O(n log n) weight sort: at an
+    accepted capacity configuration no valid clique is ever dropped,
+    so ordering within the buffer carries no meaning.
+    """
+    valid = block["valid"]
+    pos = jnp.cumsum(valid) - 1
+    ok = valid & (pos < keep)
+    tgt = jnp.where(ok, pos, keep)  # slot `keep` is the trash slot
+    out = {}
+    for k2, v in block.items():
+        if k2 == "valid":
+            continue
+        buf = jnp.zeros((keep + 1,) + v.shape[1:], v.dtype)
+        out[k2] = buf.at[tgt].set(v)[:keep]
+    out["valid"] = (
+        jnp.zeros(keep + 1, bool).at[tgt].set(ok)[:keep]
+    )
+    return out
 
 
 def compact_cliques(cs: CliqueSet, capacity: int) -> CliqueSet:
@@ -204,4 +448,6 @@ def compact_cliques(cs: CliqueSet, capacity: int) -> CliqueSet:
         rep_slot=cs.rep_slot[order],
         rep_xy=cs.rep_xy[order],
         max_adjacency=cs.max_adjacency,
+        max_cell_count=cs.max_cell_count,
+        num_valid=cs.num_valid,
     )

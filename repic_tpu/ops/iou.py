@@ -23,27 +23,55 @@ import jax
 import jax.numpy as jnp
 
 
-def pair_iou(xy_a: jax.Array, xy_b: jax.Array, box_size) -> jax.Array:
-    """All-pairs IoU between two sets of equal-size square boxes.
+def pair_iou(
+    xy_a: jax.Array, xy_b: jax.Array, box_size, box_size_b=None
+) -> jax.Array:
+    """All-pairs IoU between two sets of square boxes.
 
     Args:
         xy_a: ``(Na, 2)`` lower-left corner coordinates.
         xy_b: ``(Nb, 2)`` lower-left corner coordinates.
-        box_size: scalar box edge length (pixels).
+        box_size: scalar box edge length of set a (pixels).
+        box_size_b: set b's edge length (default: same as set a).
 
     Returns:
         ``(Na, Nb)`` IoU matrix in ``[0, 1]``.
     """
-    box_size = jnp.asarray(box_size, xy_a.dtype)
-    lo = jnp.maximum(xy_a[:, None, :], xy_b[None, :, :])
-    hi = jnp.minimum(xy_a[:, None, :], xy_b[None, :, :]) + box_size
-    ov = jnp.maximum(hi - lo, 0.0)
-    inter = ov[..., 0] * ov[..., 1]
-    return inter / (2.0 * box_size * box_size - inter)
+    return pair_iou_xy(
+        xy_a[:, None, 0], xy_a[:, None, 1],
+        xy_b[None, :, 0], xy_b[None, :, 1],
+        box_size, box_size_b,
+    )
 
 
-def pairwise_iou_matrix(xy_a, mask_a, xy_b, mask_b, box_size) -> jax.Array:
+def pair_iou_xy(xa, ya, xb, yb, box_size, box_size_b=None) -> jax.Array:
+    """Elementwise IoU from separate x/y coordinate arrays.
+
+    Structure-of-arrays variant: on TPU, gathers that produce a
+    trailing dim-2 axis get tile-padded 2 -> 128 (a 64x memory blowup
+    at stress scale), so the hot paths gather x and y separately and
+    use this form.
+
+    With ``box_size_b`` set, the two sets may have different box
+    sizes (mixed-ensemble support): union = sa^2 + sb^2 - inter,
+    which reduces to the reference's ``2 b^2 - inter`` when equal.
+    """
+    sa = jnp.asarray(box_size, xa.dtype)
+    sb = sa if box_size_b is None else jnp.asarray(box_size_b, xa.dtype)
+    ovx = jnp.maximum(
+        jnp.minimum(xa + sa, xb + sb) - jnp.maximum(xa, xb), 0.0
+    )
+    ovy = jnp.maximum(
+        jnp.minimum(ya + sa, yb + sb) - jnp.maximum(ya, yb), 0.0
+    )
+    inter = ovx * ovy
+    return inter / (sa * sa + sb * sb - inter)
+
+
+def pairwise_iou_matrix(
+    xy_a, mask_a, xy_b, mask_b, box_size, box_size_b=None
+) -> jax.Array:
     """Masked all-pairs IoU: entries involving padded slots are 0."""
-    iou = pair_iou(xy_a, xy_b, box_size)
+    iou = pair_iou(xy_a, xy_b, box_size, box_size_b)
     valid = mask_a[:, None] & mask_b[None, :]
     return jnp.where(valid, iou, 0.0)

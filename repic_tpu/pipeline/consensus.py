@@ -25,9 +25,14 @@ from repic_tpu.ops.cliques import (
     DEFAULT_THRESHOLD,
     compact_cliques,
     enumerate_cliques,
+    enumerate_cliques_bucketed,
 )
-from repic_tpu.ops.solver import pack_cliques_for_solver, solve_greedy
-from repic_tpu.parallel.batching import PaddedBatch, pad_batch
+from repic_tpu.ops.solver import (
+    pack_cliques_for_solver,
+    solve_greedy,
+    solve_lp_rounding,
+)
+from repic_tpu.parallel.batching import PaddedBatch, bucket_size, pad_batch
 from repic_tpu.parallel.mesh import (
     MICROGRAPH_AXIS,
     consensus_mesh,
@@ -48,6 +53,7 @@ class ConsensusResult(NamedTuple):
     valid: jax.Array        # (Cmax,) bool — real clique
     num_cliques: jax.Array  # () int32 — valid cliques before compaction
     max_adjacency: jax.Array  # () int32 — neighbor-list overflow probe
+    max_cell_count: jax.Array  # () int32 — bucket overflow probe (0 = dense)
 
 
 def consensus_one(
@@ -59,21 +65,47 @@ def consensus_one(
     threshold: float = DEFAULT_THRESHOLD,
     max_neighbors: int = 16,
     clique_capacity: int = 4096,
+    spatial_grid: int | None = None,
+    cell_capacity: int = 64,
+    solver: str = "greedy",
 ) -> ConsensusResult:
-    """Full consensus for one micrograph (jit/vmap-friendly)."""
+    """Full consensus for one micrograph (jit/vmap-friendly).
+
+    With ``spatial_grid`` set, neighbor search runs on the
+    memory-bounded bucketed path (dense-field micrographs); otherwise
+    the dense all-pairs kernel is used.  ``solver`` picks the packing
+    backend: ``"greedy"`` (parallel greedy dominance) or ``"lp"``
+    (LP relaxation + rounding, never worse than greedy).
+    """
     n = xy.shape[1]
-    cs = enumerate_cliques(
-        xy,
-        conf,
-        mask,
-        box_size,
-        threshold=threshold,
-        max_neighbors=max_neighbors,
-    )
-    num_cliques = jnp.sum(cs.valid).astype(jnp.int32)
+    if spatial_grid is not None:
+        cs = enumerate_cliques_bucketed(
+            xy,
+            conf,
+            mask,
+            box_size,
+            threshold=threshold,
+            max_neighbors=max_neighbors,
+            grid=spatial_grid,
+            cell_capacity=cell_capacity,
+            clique_capacity=clique_capacity,
+        )
+    else:
+        cs = enumerate_cliques(
+            xy,
+            conf,
+            mask,
+            box_size,
+            threshold=threshold,
+            max_neighbors=max_neighbors,
+        )
+    num_cliques = cs.num_valid
     cs = compact_cliques(cs, clique_capacity)
     vid, num_vertices = pack_cliques_for_solver(cs.member_idx, cs.valid, n)
-    picked = solve_greedy(vid, cs.w, cs.valid, num_vertices)
+    if solver == "lp":
+        picked = solve_lp_rounding(vid, cs.w, cs.valid, num_vertices)
+    else:
+        picked = solve_greedy(vid, cs.w, cs.valid, num_vertices)
     return ConsensusResult(
         rep_xy=cs.rep_xy,
         confidence=cs.confidence,
@@ -84,6 +116,7 @@ def consensus_one(
         valid=cs.valid,
         num_cliques=num_cliques,
         max_adjacency=cs.max_adjacency,
+        max_cell_count=cs.max_cell_count,
     )
 
 
@@ -93,6 +126,9 @@ def make_batched_consensus(
     max_neighbors: int = 16,
     clique_capacity: int = 4096,
     mesh=None,
+    spatial_grid: int | None = None,
+    cell_capacity: int = 64,
+    solver: str = "greedy",
 ):
     """Build the jitted batched consensus fn, sharded over micrographs.
 
@@ -103,16 +139,25 @@ def make_batched_consensus(
     instead of re-tracing — compile time dwarfs execution for this
     workload, so this cache IS the fast path.
     """
-    return _make_batched_consensus(threshold, max_neighbors, clique_capacity, mesh)
+    return _make_batched_consensus(
+        threshold, max_neighbors, clique_capacity, mesh,
+        spatial_grid, cell_capacity, solver,
+    )
 
 
 @lru_cache(maxsize=64)
-def _make_batched_consensus(threshold, max_neighbors, clique_capacity, mesh):
+def _make_batched_consensus(
+    threshold, max_neighbors, clique_capacity, mesh,
+    spatial_grid, cell_capacity, solver="greedy",
+):
     single = partial(
         consensus_one,
         threshold=threshold,
         max_neighbors=max_neighbors,
         clique_capacity=clique_capacity,
+        spatial_grid=spatial_grid,
+        cell_capacity=cell_capacity,
+        solver=solver,
     )
     batched = jax.vmap(single, in_axes=(0, 0, 0, None))
     if mesh is None:
@@ -127,6 +172,21 @@ def _make_batched_consensus(threshold, max_neighbors, clique_capacity, mesh):
     )
 
 
+SPATIAL_THRESHOLD = 4096  # particle count above which the bucketed
+# (O(N * 9B)-memory) path replaces the dense O(N^2) kernel
+
+# Last sufficient (max_neighbors, clique_capacity, cell_capacity) per
+# workload shape: each distinct capacity config costs a full XLA
+# compile, so repeated batches of the same shape skip the escalation
+# ladder entirely.
+_LAST_GOOD_CONFIG: dict = {}
+
+
+def _next_pow2(x: int) -> int:
+    # shared power-of-two bucketing policy (recompile-stable sizes)
+    return bucket_size(int(x), minimum=2)
+
+
 def run_consensus_batch(
     batch: PaddedBatch,
     box_size,
@@ -135,35 +195,85 @@ def run_consensus_batch(
     max_neighbors: int = 16,
     clique_capacity: int | None = None,
     use_mesh: bool = True,
+    spatial: bool | None = None,
+    solver: str = "greedy",
 ) -> ConsensusResult:
     """Run batched consensus on host data with automatic escalation.
 
-    If the neighbor-list capacity or clique capacity overflows (dense
+    If the neighbor-list, clique, or bucket capacity overflows (dense
     micrographs), the batch is re-run with doubled capacity — the
     static-shape analog of the reference's unbounded Python loops.
+    ``spatial`` selects the bucketed neighbor search; default (None)
+    picks it automatically for batches above ``SPATIAL_THRESHOLD``
+    particles per picker.
     """
     cap = clique_capacity or max(4 * batch.capacity, 1024)
     d = max_neighbors
     mesh = consensus_mesh() if use_mesh else None
+    if spatial is None:
+        spatial = batch.capacity > SPATIAL_THRESHOLD
+    # box_size may be a scalar or one size per picker (mixed-size
+    # ensembles); spatial hashing always uses the largest.
+    sizes = np.asarray(box_size, np.float32)
+    max_size = float(sizes.max())
+    box_arg = sizes if sizes.ndim else float(box_size)
+    grid = None
+    cell_cap = 64
+    if spatial:
+        from repic_tpu.ops.spatial import grid_size
+
+        extent = float(np.max(batch.xy)) + max_size
+        grid = grid_size(extent, max_size)
+        real_counts = batch.mask.sum(2).max()
+        # 2x the mean density as slack; escalation handles the tail
+        mean_per_cell = float(real_counts) / max(grid * grid, 1)
+        cell_cap = int(
+            2 ** np.ceil(np.log2(max(2 * mean_per_cell + 8, 16)))
+        )
+    cfg_key = (
+        batch.xy.shape,
+        tuple(sizes.reshape(-1).tolist()),
+        threshold,
+        bool(spatial),
+    )
+    known = _LAST_GOOD_CONFIG.get(cfg_key)
+    if known:
+        d = max(d, known[0])
+        cap = max(cap, known[1])
+        cell_cap = max(cell_cap, known[2])
     while True:
         fn = make_batched_consensus(
             threshold=threshold,
             max_neighbors=d,
             clique_capacity=cap,
             mesh=mesh,
+            spatial_grid=grid,
+            cell_capacity=cell_cap,
+            solver=solver,
         )
         xy, conf, mask = batch.xy, batch.conf, batch.mask
         if mesh is not None:
             xy, conf, mask = shard_over_micrographs(mesh, xy, conf, mask)
-        res = fn(xy, conf, mask, float(box_size))
+        res = fn(xy, conf, mask, box_arg)
+        # Escalate straight to the observed requirement (each distinct
+        # capacity config is a fresh XLA compile — don't ladder by 2x).
         max_adj = int(jnp.max(res.max_adjacency))
         n_cliques = int(jnp.max(res.num_cliques))
+        retry = False
+        if grid is not None:
+            max_cell = int(jnp.max(res.max_cell_count))
+            if max_cell > cell_cap:
+                cell_cap = _next_pow2(max_cell)
+                retry = True
         if max_adj > d:
-            d = 2 * d
-            continue
+            d = _next_pow2(max_adj)
+            retry = True
         if n_cliques > cap:
-            cap = 2 * cap
+            cap = _next_pow2(n_cliques)
+            retry = True
+        if retry:
             continue
+        _LAST_GOOD_CONFIG[cfg_key] = (d, cap, cell_cap)
         return res
 
 
@@ -185,17 +295,24 @@ def write_consensus_boxes(
     picked = np.asarray(res.picked)
     rep_xy = np.asarray(res.rep_xy)
     confidence = np.asarray(res.confidence)
+    rep_slot = np.asarray(res.rep_slot)
+    sizes = np.asarray(box_size)
     counts = {}
     for i, name in enumerate(batch.names):
         if not name:
             continue
         sel = np.where(picked[i])[0]
         out = os.path.join(out_dir, name + ".box")
+        # mixed-size ensembles write each row with its representative
+        # picker's box size; the scalar case is the reference format
+        row_sizes = (
+            sizes[rep_slot[i, sel]] if sizes.ndim else box_size
+        )
         box_io.write_box(
             out,
             rep_xy[i, sel],
             confidence[i, sel],
-            box_size,
+            row_sizes,
             num_particles=num_particles,
         )
         counts[name] = len(sel) if num_particles is None else min(
@@ -213,6 +330,8 @@ def run_consensus_dir(
     max_neighbors: int = 16,
     num_particles: int | None = None,
     use_mesh: bool = True,
+    spatial: bool | None = None,
+    solver: str = "greedy",
 ) -> dict:
     """End-to-end: read picker BOX dirs, consensus, write BOX files.
 
@@ -222,6 +341,9 @@ def run_consensus_dir(
     """
     import shutil
 
+    from repic_tpu.utils.tracing import StageTimer, annotate
+
+    timer = StageTimer()
     t0 = time.time()
     pickers = box_io.discover_picker_dirs(in_dir)
     if not pickers:
@@ -254,21 +376,29 @@ def run_consensus_dir(
     if not loaded:
         return stats
 
+    timer.stages.append(("load", time.time() - t0))
     n_dev = len(jax.devices()) if use_mesh else 1
     batch = pad_batch(loaded, pad_micrographs_to=n_dev)
     t1 = time.time()
-    res = run_consensus_batch(
-        batch,
-        box_size,
-        threshold=threshold,
-        max_neighbors=max_neighbors,
-        use_mesh=use_mesh,
-    )
-    jax.block_until_ready(res.picked)
+    with timer.stage("compute"), annotate("consensus_batch"):
+        res = run_consensus_batch(
+            batch,
+            box_size,
+            threshold=threshold,
+            max_neighbors=max_neighbors,
+            use_mesh=use_mesh,
+            spatial=spatial,
+            solver=solver,
+        )
+        jax.block_until_ready(res.picked)
     t2 = time.time()
-    counts = write_consensus_boxes(
-        batch, res, out_dir, box_size, num_particles=num_particles
-    )
+    with timer.stage("write"):
+        counts = write_consensus_boxes(
+            batch, res, out_dir, box_size, num_particles=num_particles
+        )
+    # per-run runtime TSV, the reference's observability surface
+    # (get_cliques.py:224-229 / run_ilp.py:132-136)
+    timer.write_tsv(out_dir, "consensus_runtime.tsv")
     stats.update(
         compute_s=t2 - t1,
         write_s=time.time() - t2,

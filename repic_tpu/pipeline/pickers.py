@@ -332,6 +332,8 @@ class DeepPickerExternal(ExternalPicker):
                 "deep: set deep_dir to the DeepPicker checkout "
                 "(iter_config --deep_dir)"
             )
+        if not self.model_path:
+            raise PickerError("deep: no model weights configured")
         os.makedirs(out_box_dir, exist_ok=True)
         work = os.path.join(out_box_dir, "_deep_work")
         os.makedirs(work, exist_ok=True)
@@ -385,11 +387,17 @@ class TopazPicker(ExternalPicker):
         )
 
     def predict_cmd(self, down_dir, out_file):
-        # run_topaz.sh:19-36
+        # run_topaz.sh:19-36 (the Bash adapter relied on shell glob
+        # expansion; subprocess has no shell, so enumerate the files)
         cmd = ["topaz", "extract", "-r", str(self.radius)]
         if self.model_path:
             cmd += ["-m", self.model_path]
-        cmd += ["-o", out_file, os.path.join(down_dir, "*.mrc")]
+        cmd += ["-o", out_file]
+        cmd += sorted(
+            os.path.join(down_dir, f)
+            for f in os.listdir(down_dir)
+            if f.endswith(".mrc")
+        )
         return cmd
 
     def fit_cmd(self, train_dir, targets, model_out, expected):

@@ -94,9 +94,14 @@ def write_box(
     order = np.argsort(-weights, kind="stable") if sort else np.arange(len(weights))
     if num_particles is not None:
         order = order[:num_particles]
-    bs = str(int(box_size))
+    # scalar box size (the reference's only mode), or one per row for
+    # mixed-size ensembles
+    sizes = np.broadcast_to(
+        np.asarray(box_size).reshape(-1), (len(weights),)
+    )
     with open(path, "wt") as o:
         for i in order:
+            bs = str(int(sizes[i]))
             o.write(
                 "\t".join(
                     [

@@ -1,0 +1,92 @@
+"""Tracing / profiling subsystem.
+
+The reference's only observability is hand-rolled wall-clock logging:
+per-micrograph runtime TSVs (reference: repic/commands/
+get_cliques.py:224-229, run_ilp.py:132-136) and START/END timers in
+every Bash adapter (e.g. run_cryolo.sh:8,41-46).  This module keeps
+that TSV surface for drop-in comparability and adds what the
+reference never had: real device profiling via ``jax.profiler``
+(XLA-level traces viewable in TensorBoard/Perfetto) and a structured
+stage timer.
+
+Usage::
+
+    with trace_session("/tmp/prof"):          # device + host trace
+        ...
+
+    timer = StageTimer()
+    with timer.stage("load"):
+        ...
+    timer.write_tsv(out_dir)                  # stage\tseconds rows
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from dataclasses import dataclass, field
+
+
+@contextlib.contextmanager
+def trace_session(trace_dir: str | None):
+    """XLA/device profiler trace (no-op when ``trace_dir`` is None).
+
+    Produces a TensorBoard/Perfetto-compatible trace of every XLA
+    launch, transfer, and host event under ``trace_dir`` — the TPU
+    equivalent of the profiler integration the reference lacks
+    (SURVEY.md section 5: wall-clock only).
+    """
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    os.makedirs(trace_dir, exist_ok=True)
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+@dataclass
+class StageTimer:
+    """Named wall-clock stages, written as a runtime TSV.
+
+    The TSV shape matches the reference's ``*_runtime.tsv`` habit
+    (one row per stage, tab-separated) so downstream log-forensics
+    tooling keeps working.
+    """
+
+    stages: list = field(default_factory=list)
+
+    @contextlib.contextmanager
+    def stage(self, label: str):
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            self.stages.append((label, time.time() - t0))
+
+    def as_dict(self) -> dict:
+        return {label: secs for label, secs in self.stages}
+
+    def write_tsv(self, out_dir: str, name: str = "runtime.tsv") -> str:
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, name)
+        with open(path, "wt") as f:
+            for label, secs in self.stages:
+                f.write(f"{label}\t{secs:.6f}\n")
+        return path
+
+
+def annotate(label: str):
+    """Named profiler span (shows up in the device trace timeline).
+
+    Thin wrapper over ``jax.profiler.TraceAnnotation`` that degrades
+    to a no-op outside an active trace or when jax is unavailable.
+    """
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(label)
+    except Exception:  # pragma: no cover
+        return contextlib.nullcontext()

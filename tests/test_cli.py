@@ -144,3 +144,55 @@ def test_fused_matches_two_phase_greedy(tmp_path):
             for l in (out_two / f"{name}.box").read_text().splitlines()
         }
         assert fused == two
+
+
+def test_get_examples_offline_fails_cleanly(tmp_path, monkeypatch):
+    """Without network, get_examples must exit with a clear message
+    (not a traceback) and leave no partial files behind."""
+    import urllib.request
+
+    def no_net(url, timeout=None):
+        raise OSError("no route to host")
+
+    monkeypatch.setattr(urllib.request, "urlopen", no_net)
+    with pytest.raises(SystemExit) as e:
+        cli_main(["get_examples", str(tmp_path / "ex")])
+    assert "download failed" in str(e.value)
+    leftovers = [
+        f for f in os.listdir(tmp_path / "ex") if not f.endswith(".part")
+    ]
+    assert leftovers == []
+
+
+def test_get_examples_skips_existing(tmp_path, monkeypatch, capsys):
+    """Complete files are not re-downloaded (resumable fetch)."""
+    from repic_tpu.commands.get_examples import FILE_STEMS
+
+    ex = tmp_path / "ex"
+    ex.mkdir()
+    for stem in FILE_STEMS:
+        for ext in (".mrc", ".box"):
+            (ex / (stem + ext)).write_bytes(b"x")
+    import urllib.request
+
+    def boom(url, timeout=None):  # must never be called
+        raise AssertionError("unexpected download")
+
+    monkeypatch.setattr(urllib.request, "urlopen", boom)
+    cli_main(["get_examples", str(ex)])
+    out = capsys.readouterr().out
+    assert f"skipped {2 * len(FILE_STEMS)} existing" in out
+
+
+def test_fused_consensus_writes_runtime_tsv(tmp_path, rng):
+    """The fused path keeps the reference's runtime-TSV observability
+    surface (reference get_cliques.py:224-229)."""
+    in_dir, _ = _write_picker_dirs(tmp_path, rng, n_micro=2)
+    out_dir = tmp_path / "out"
+    cli_main(["consensus", str(in_dir), str(out_dir), "180", "--no_mesh"])
+    tsv = out_dir / "consensus_runtime.tsv"
+    assert tsv.exists()
+    stages = dict(
+        line.split("\t") for line in tsv.read_text().splitlines()
+    )
+    assert {"load", "compute", "write"} <= set(stages)

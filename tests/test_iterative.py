@@ -276,3 +276,29 @@ def test_iterative_end_to_end_builtin(dataset, tmp_path):
             parts = line.split("\t")
             f1s.append(float(parts[3]))
     assert np.mean(f1s) > 0.5
+
+
+def test_topaz_predict_cmd_enumerates_files(tmp_path):
+    """subprocess has no shell: the extract command must list the
+    downsampled micrographs explicitly, not pass a glob."""
+    d = tmp_path / "down"
+    d.mkdir()
+    (d / "b.mrc").write_bytes(b"")
+    (d / "a.mrc").write_bytes(b"")
+    (d / "notes.txt").write_text("x")
+    topaz = pickers_mod.TopazPicker(
+        name="topaz", conda_env="topaz", particle_size=180
+    )
+    cmd = topaz.predict_cmd(str(d), "out.txt")
+    assert str(d / "a.mrc") in cmd and str(d / "b.mrc") in cmd
+    assert not any("*" in c for c in cmd)
+    assert not any(c.endswith("notes.txt") for c in cmd)
+
+
+def test_deep_predict_requires_model(tmp_path):
+    deep = pickers_mod.DeepPickerExternal(
+        name="deep", conda_env="deep", particle_size=180,
+        deep_dir="/x",
+    )
+    with pytest.raises(pickers_mod.PickerError, match="no model"):
+        deep.predict(str(tmp_path), str(tmp_path / "o"))

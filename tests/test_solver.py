@@ -148,3 +148,74 @@ def test_vmap_batched(rng):
     for i in range(4):
         want = sequential_greedy(np.asarray(mvs[i]), np.asarray(ws[i]), np.ones(30, bool))
         np.testing.assert_array_equal(got[i], want)
+
+
+def test_lp_rounding_never_worse_than_greedy(rng):
+    from repic_tpu.ops.solver import solve_lp_rounding
+
+    for trial in range(15):
+        mv, w = random_instance(rng, 40, 3, 25)
+        valid = rng.uniform(size=40) > 0.1
+        g = np.asarray(
+            solve_greedy(jnp.asarray(mv), jnp.asarray(w), jnp.asarray(valid), 25)
+        )
+        lp = np.asarray(
+            solve_lp_rounding(
+                jnp.asarray(mv), jnp.asarray(w), jnp.asarray(valid), 25
+            )
+        )
+        # feasible: no vertex shared between two selected cliques
+        # (a random instance may repeat a vertex inside one clique;
+        # real k-partite cliques cannot, so dedupe per clique)
+        used = [
+            v for c in np.where(lp)[0] for v in set(map(int, mv[c]))
+        ]
+        assert len(used) == len(set(used))
+        assert not (lp & ~valid).any()
+        assert w[lp].sum() >= w[g].sum() - 1e-6
+
+
+def test_lp_rounding_beats_greedy_on_chain():
+    """The adversarial chain where greedy is suboptimal: LP pricing
+    recovers the exact optimum."""
+    from repic_tpu.ops.solver import solve_lp_rounding
+
+    mv = np.array([[0, 1, 2], [2, 3, 4], [4, 5, 6]], np.int32)
+    w = np.array([0.6, 1.0, 0.6], np.float32)
+    valid = np.ones(3, bool)
+    lp = np.asarray(
+        solve_lp_rounding(
+            jnp.asarray(mv), jnp.asarray(w), jnp.asarray(valid), 7
+        )
+    )
+    assert np.isclose(w[lp].sum(), 1.2)
+
+
+def test_lp_rounding_close_to_exact(rng):
+    """On adversarial random conflict soups (14 cliques over just 12
+    vertices — far denser than real consensus problems), LP pricing
+    must close part of the greedy-to-exact gap and stay within 10% of
+    the optimum."""
+    from repic_tpu.ops.solver import solve_exact_py, solve_lp_rounding
+
+    total_lp = total_greedy = total_exact = 0.0
+    for trial in range(10):
+        mv, w = random_instance(rng, 14, 3, 12)
+        lp = np.asarray(
+            solve_lp_rounding(
+                jnp.asarray(mv), jnp.asarray(w),
+                jnp.ones(14, bool), 12,
+            )
+        )
+        g = np.asarray(
+            solve_greedy(jnp.asarray(mv), jnp.asarray(w), jnp.ones(14, bool), 12)
+        )
+        e = solve_exact_py(mv, w.astype(np.float64))
+        total_lp += w[lp].sum()
+        total_greedy += w[g].sum()
+        total_exact += w[e].sum()
+    assert total_lp >= 0.90 * total_exact
+    # never worse than greedy in aggregate (strict improvement is
+    # seed-dependent; test_lp_rounding_beats_greedy_on_chain pins a
+    # case where pricing strictly wins)
+    assert total_lp >= total_greedy - 1e-6

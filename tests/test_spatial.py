@@ -1,0 +1,296 @@
+"""Spatial bucketing tests: the bucketed clique enumeration must
+reproduce the dense path exactly (same clique set, weights,
+representatives) while never materializing O(N^2) IoU matrices, and
+must remain complete under per-cell overflow escalation."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from repic_tpu.ops.cliques import (
+    enumerate_cliques,
+    enumerate_cliques_bucketed,
+)
+from repic_tpu.ops.iou import pair_iou
+from repic_tpu.ops.spatial import (
+    bucket_particles,
+    bucketed_neighbor_iou,
+    grid_size,
+)
+
+BOX = 180.0
+
+
+def _random_micrograph(rng, k=3, n=200, extent=4000.0, jitter=25.0):
+    base = rng.uniform(0, extent - BOX, size=(n, 2))
+    xy = np.stack(
+        [base + rng.normal(0, jitter, size=base.shape) for _ in range(k)]
+    ).astype(np.float32)
+    conf = rng.uniform(0.05, 1.0, size=(k, n)).astype(np.float32)
+    mask = np.ones((k, n), bool)
+    # mask out a ragged tail per picker
+    for p in range(k):
+        mask[p, n - rng.integers(0, n // 4) :] = False
+    return jnp.asarray(xy), jnp.asarray(conf), jnp.asarray(mask)
+
+
+def _clique_key_set(cs):
+    m = np.asarray(cs.member_idx)[np.asarray(cs.valid)]
+    return {tuple(row) for row in m}
+
+
+def test_bucket_table_complete():
+    rng = np.random.default_rng(0)
+    xy = jnp.asarray(rng.uniform(0, 2000, size=(300, 2)), jnp.float32)
+    mask = jnp.asarray(rng.uniform(size=300) > 0.1)
+    g = grid_size(2000 + BOX, BOX)
+    bt = bucket_particles(xy, mask, BOX, grid=g, cell_capacity=64)
+    assert int(bt.max_count) <= 64
+    table = np.asarray(bt.table)
+    listed = table[table < 300]
+    # every unmasked particle appears exactly once
+    assert sorted(listed) == sorted(np.where(np.asarray(mask))[0])
+
+
+def test_bucketed_neighbor_iou_matches_dense():
+    rng = np.random.default_rng(1)
+    xa = jnp.asarray(rng.uniform(0, 1500, size=(128, 2)), jnp.float32)
+    xb = xa + jnp.asarray(
+        rng.normal(0, 40, size=(128, 2)), jnp.float32
+    )
+    ma = jnp.ones(128, bool)
+    g = grid_size(1500 + BOX, BOX)
+    bta = bucket_particles(xa, ma, BOX, grid=g, cell_capacity=32)
+    btb = bucket_particles(xb, ma, BOX, grid=g, cell_capacity=32)
+    iou_c, idx_c = bucketed_neighbor_iou(xa, ma, bta, xb, ma, btb, BOX)
+    dense = np.asarray(pair_iou(xa, xb, BOX))
+    iou_c, idx_c = np.asarray(iou_c), np.asarray(idx_c)
+    # reconstruct a dense matrix from the candidate lists
+    rebuilt = np.zeros_like(dense)
+    for i in range(128):
+        sel = idx_c[i] < 128
+        rebuilt[i, idx_c[i][sel]] = iou_c[i][sel]
+    # all positive-IoU entries must be recovered (prefilter complete)
+    np.testing.assert_allclose(
+        np.where(dense > 1e-6, dense, 0.0), rebuilt, atol=1e-6
+    )
+
+
+@pytest.mark.parametrize("k", [2, 3, 4])
+def test_bucketed_cliques_match_dense(k):
+    rng = np.random.default_rng(2 + k)
+    xy, conf, mask = _random_micrograph(rng, k=k, n=160)
+    g = grid_size(4000 + BOX, BOX)
+    dense = enumerate_cliques(
+        xy, conf, mask, BOX, max_neighbors=8
+    )
+    bucketed = enumerate_cliques_bucketed(
+        xy, conf, mask, BOX, max_neighbors=8, grid=g, cell_capacity=32
+    )
+    assert int(bucketed.max_cell_count) <= 32
+    assert _clique_key_set(dense) == _clique_key_set(bucketed)
+    # weights agree clique-by-clique
+    dw = {
+        tuple(m): w
+        for m, w, v in zip(
+            np.asarray(dense.member_idx),
+            np.asarray(dense.w),
+            np.asarray(dense.valid),
+        )
+        if v
+    }
+    bw = {
+        tuple(m): w
+        for m, w, v in zip(
+            np.asarray(bucketed.member_idx),
+            np.asarray(bucketed.w),
+            np.asarray(bucketed.valid),
+        )
+        if v
+    }
+    for key, w in dw.items():
+        np.testing.assert_allclose(w, bw[key], rtol=1e-5)
+
+
+def test_bucketed_overflow_detected():
+    """Cramming many particles into one cell must be reported, not
+    silently truncated."""
+    rng = np.random.default_rng(9)
+    n = 64
+    xy = jnp.asarray(
+        rng.uniform(0, 50, size=(2, n, 2)), jnp.float32
+    )  # all in one box-size cell
+    conf = jnp.ones((2, n), jnp.float32)
+    mask = jnp.ones((2, n), bool)
+    cs = enumerate_cliques_bucketed(
+        xy, conf, mask, BOX, grid=8, cell_capacity=8
+    )
+    assert int(cs.max_cell_count) == n  # overflow visible to caller
+
+
+def test_run_consensus_batch_spatial_matches_dense():
+    from repic_tpu.parallel.batching import pad_batch
+    from repic_tpu.pipeline.consensus import run_consensus_batch
+    from repic_tpu.utils.box_io import BoxSet
+
+    rng = np.random.default_rng(5)
+    loaded = []
+    for i in range(2):
+        sets = []
+        base = rng.uniform(0, 3800, size=(150, 2))
+        for p in range(3):
+            pts = base + rng.normal(0, 30, size=base.shape)
+            sets.append(
+                BoxSet(
+                    xy=pts.astype(np.float32),
+                    conf=rng.uniform(0.1, 1, 150).astype(np.float32),
+                    wh=np.full((150, 2), BOX, np.float32),
+                )
+            )
+        loaded.append((f"m{i}", sets))
+    batch = pad_batch(loaded)
+    dense = run_consensus_batch(
+        batch, BOX, use_mesh=False, spatial=False
+    )
+    spatial = run_consensus_batch(
+        batch, BOX, use_mesh=False, spatial=True
+    )
+    for i in range(2):
+        dk = {
+            tuple(m)
+            for m, p in zip(
+                np.asarray(dense.member_idx[i]),
+                np.asarray(dense.picked[i]),
+            )
+            if p
+        }
+        sk = {
+            tuple(m)
+            for m, p in zip(
+                np.asarray(spatial.member_idx[i]),
+                np.asarray(spatial.picked[i]),
+            )
+            if p
+        }
+        assert dk == sk
+
+
+def test_chunked_assembly_matches_dense():
+    """Anchor-chunked, stream-compacted enumeration returns the same
+    clique set as the dense path (ordering aside)."""
+    rng = np.random.default_rng(11)
+    xy, conf, mask = _random_micrograph(rng, k=3, n=128)
+    g = grid_size(4000 + BOX, BOX)
+    dense = enumerate_cliques(xy, conf, mask, BOX, max_neighbors=8)
+    chunked = enumerate_cliques_bucketed(
+        xy, conf, mask, BOX, max_neighbors=8, grid=g,
+        cell_capacity=32, clique_capacity=512, anchor_chunk=16,
+    )
+    assert int(chunked.num_valid) == int(dense.num_valid)
+    assert _clique_key_set(dense) == _clique_key_set(chunked)
+    dw = {
+        tuple(m): w
+        for m, w, v in zip(
+            np.asarray(dense.member_idx),
+            np.asarray(dense.w),
+            np.asarray(dense.valid),
+        )
+        if v
+    }
+    cw = {
+        tuple(m): w
+        for m, w, v in zip(
+            np.asarray(chunked.member_idx),
+            np.asarray(chunked.w),
+            np.asarray(chunked.valid),
+        )
+        if v
+    }
+    assert dw.keys() == cw.keys()
+    for key in dw:
+        np.testing.assert_allclose(dw[key], cw[key], rtol=1e-5)
+
+
+def test_chunked_capacity_overflow_visible():
+    """When clique_capacity is too small, num_valid still reports the
+    true count so escalation triggers."""
+    rng = np.random.default_rng(12)
+    xy, conf, mask = _random_micrograph(rng, k=3, n=64)
+    g = grid_size(4000 + BOX, BOX)
+    full = enumerate_cliques_bucketed(
+        xy, conf, mask, BOX, max_neighbors=8, grid=g,
+        cell_capacity=32, clique_capacity=4096, anchor_chunk=16,
+    )
+    true_count = int(full.num_valid)
+    assert true_count > 2
+    tiny = enumerate_cliques_bucketed(
+        xy, conf, mask, BOX, max_neighbors=8, grid=g,
+        cell_capacity=32, clique_capacity=2, anchor_chunk=16,
+    )
+    assert int(tiny.num_valid) == true_count  # overflow not hidden
+    assert int(np.asarray(tiny.valid).sum()) <= 2
+
+
+def test_mixed_box_sizes_k5():
+    """k=5 ensemble with per-picker box sizes: IoU uses
+    inter/(sa^2+sb^2-inter) and the whole pipeline (dense and
+    bucketed) agrees."""
+    from repic_tpu.ops.iou import pair_iou_xy
+
+    # closed form: corner boxes (0,0) size 100 and (10,10) size 140
+    ov = min(0 + 100, 10 + 140) - max(0, 10)  # = 90
+    inter = ov * ov
+    expect = inter / (100.0**2 + 140.0**2 - inter)
+    got = float(
+        pair_iou_xy(
+            jnp.float32(0), jnp.float32(0),
+            jnp.float32(10), jnp.float32(10),
+            100.0, 140.0,
+        )
+    )
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+    rng = np.random.default_rng(7)
+    k = 5
+    xy, conf, mask = _random_micrograph(rng, k=k, n=96, jitter=15.0)
+    sizes = jnp.asarray([180.0, 160.0, 200.0, 180.0, 150.0])
+    g = grid_size(4000 + 200, 200)
+    dense = enumerate_cliques(xy, conf, mask, sizes, max_neighbors=4)
+    bucketed = enumerate_cliques_bucketed(
+        xy, conf, mask, sizes, max_neighbors=4, grid=g,
+        cell_capacity=32,
+    )
+    assert int(dense.num_valid) > 0
+    assert _clique_key_set(dense) == _clique_key_set(bucketed)
+
+
+def test_mixed_box_sizes_batch_output(tmp_path):
+    """End-to-end mixed-size consensus writes each row with its
+    representative picker's box size."""
+    from repic_tpu.parallel.batching import pad_batch
+    from repic_tpu.pipeline.consensus import (
+        run_consensus_batch,
+        write_consensus_boxes,
+    )
+    from repic_tpu.utils.box_io import BoxSet
+
+    rng = np.random.default_rng(8)
+    sizes = np.asarray([180.0, 160.0, 200.0], np.float32)
+    base = rng.uniform(0, 2000, size=(40, 2))
+    sets = [
+        BoxSet(
+            xy=(base + rng.normal(0, 10, base.shape)).astype(np.float32),
+            conf=rng.uniform(0.2, 1, 40).astype(np.float32),
+            wh=np.full((40, 2), s, np.float32),
+        )
+        for s in sizes
+    ]
+    batch = pad_batch([("m0", sets)])
+    res = run_consensus_batch(batch, sizes, use_mesh=False)
+    assert int(np.asarray(res.picked).sum()) > 0
+    write_consensus_boxes(batch, res, str(tmp_path), sizes)
+    rows = (tmp_path / "m0.box").read_text().splitlines()
+    assert rows
+    written_sizes = {int(r.split("\t")[2]) for r in rows}
+    assert written_sizes <= {180, 160, 200}
