@@ -55,6 +55,12 @@ def add_arguments(parser):
         help="packing backend: parallel greedy dominance, or LP "
         "relaxation + rounding (never worse than greedy)",
     )
+    parser.add_argument(
+        "--pallas",
+        action="store_true",
+        help="fused Pallas neighbor-search kernel (no N x N "
+        "intermediate; interpreted off-TPU)",
+    )
 
 
 def main(args):
@@ -73,6 +79,7 @@ def main(args):
             use_mesh=not args.no_mesh,
             spatial=spatial,
             solver=args.solver,
+            use_pallas=args.pallas,
         )
     print(json.dumps(stats, default=str, indent=2))
 

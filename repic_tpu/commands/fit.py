@@ -51,6 +51,13 @@ def add_arguments(parser) -> None:
         help="warm-start from an existing checkpoint",
     )
     parser.add_argument("--seed", type=int, default=1234)
+    parser.add_argument(
+        "--arch",
+        choices=["deep", "wide", "slim"],
+        default="deep",
+        help="filter pyramid (cnn.ARCHS); 'deep' is the "
+        "reference-parity DeepPicker stack",
+    )
 
 
 def main(args) -> None:
@@ -106,6 +113,7 @@ def main(args) -> None:
         val_labels,
         config,
         init_params=init_params,
+        arch=args.arch,
     )
     save_checkpoint(
         args.model_out,
@@ -113,6 +121,7 @@ def main(args) -> None:
         {
             "particle_size": args.particle_size,
             "patch_norm": args.patch_norm,
+            "arch": args.arch,
             "best_val_error": result.best_val_error,
             "epochs": result.epochs_run,
             "seed": args.seed,

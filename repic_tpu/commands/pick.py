@@ -108,6 +108,7 @@ def main(args) -> None:
             int(particle_size),
             mode=args.mode,
             norm=norm,
+            arch=meta.get("arch", "deep"),
         )
         coords = coords[coords[:, 2] >= args.threshold]
         stem = os.path.splitext(os.path.basename(path))[0]

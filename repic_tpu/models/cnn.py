@@ -32,20 +32,60 @@ CONV_SPEC = ((9, 8), (5, 16), (3, 32), (2, 64))
 PATCH_SIZE = 64  # model input resolution (autoPick.py:48 model_input_size)
 FC_WIDTH = 128
 FC_WEIGHT_DECAY = 5e-4  # deepModel.py:164-173 (FC weights only)
-# 64x64 -> 2x2x64 after four VALID conv+pool blocks.
+# 64x64 -> 2x2xC after four VALID conv+pool blocks (every ARCHS entry
+# is constructed to land on a 2x2 feature map).
 FEAT_SPATIAL = 2
 FEAT_CHANNELS = CONV_SPEC[-1][1]
 # Output stride of the fully-convolutional head: product of the four
 # pool strides.
 FCN_STRIDE = 16
 
+# Architecture registry: the reference ensemble's diversity comes from
+# three structurally different CNN pickers; the builtin ensemble
+# mirrors that with three filter pyramids sharing the patch/FCN
+# machinery.  "deep" is the reference-parity DeepPicker stack.
+ARCHS = {
+    "deep": {"conv_spec": CONV_SPEC, "fc_width": 128},
+    "wide": {
+        "conv_spec": ((7, 16), (5, 32), (3, 64), (2, 128)),
+        "fc_width": 192,
+    },
+    "slim": {
+        "conv_spec": ((5, 8), (3, 16), (3, 32), (2, 32)),
+        "fc_width": 64,
+    },
+}
+
+
+def feature_spatial(conv_spec, patch: int = PATCH_SIZE) -> int:
+    """Feature-map edge after the VALID conv+pool pyramid."""
+    s = patch
+    for k, _ in conv_spec:
+        s = (s - k + 1) // 2
+    return s
+
+
+for _name, _a in ARCHS.items():  # every arch must land on 2x2
+    assert feature_spatial(_a["conv_spec"]) == FEAT_SPATIAL, _name
+
+
+def arch_kwargs(arch: str) -> dict:
+    if arch not in ARCHS:
+        raise ValueError(
+            f"unknown picker architecture {arch!r} "
+            f"(have {sorted(ARCHS)})"
+        )
+    return ARCHS[arch]
+
 
 class Backbone(nn.Module):
     """The four VALID conv+pool blocks shared by both heads."""
 
+    conv_spec: tuple = CONV_SPEC
+
     @nn.compact
     def __call__(self, x):
-        for i, (k, f) in enumerate(CONV_SPEC):
+        for i, (k, f) in enumerate(self.conv_spec):
             x = nn.Conv(f, (k, k), padding="VALID", name=f"conv{i + 1}")(x)
             x = nn.relu(x)
             x = nn.max_pool(x, (2, 2), strides=(2, 2), padding="VALID")
@@ -60,14 +100,16 @@ class PickerCNN(nn.Module):
     """
 
     num_class: int = 2
+    conv_spec: tuple = CONV_SPEC
+    fc_width: int = FC_WIDTH
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False):
-        x = Backbone(name="backbone")(x)
+        x = Backbone(self.conv_spec, name="backbone")(x)
         x = x.reshape(x.shape[0], -1)
         if train:
             x = nn.Dropout(rate=0.5, deterministic=False)(x)
-        x = nn.relu(nn.Dense(FC_WIDTH, name="fc1")(x))
+        x = nn.relu(nn.Dense(self.fc_width, name="fc1")(x))
         return nn.Dense(self.num_class, name="fc2")(x)
 
 
@@ -82,14 +124,16 @@ class PickerFCN(nn.Module):
     """
 
     num_class: int = 2
+    conv_spec: tuple = CONV_SPEC
+    fc_width: int = FC_WIDTH
 
     @nn.compact
     def __call__(self, x: jnp.ndarray):
-        x = Backbone(name="backbone")(x)
+        x = Backbone(self.conv_spec, name="backbone")(x)
         # fc1 as a 2x2 VALID conv over the feature map == Dense on the
-        # flattened 2x2x64 window at each output position.
+        # flattened 2x2xC window at each output position.
         x = nn.Conv(
-            FC_WIDTH,
+            self.fc_width,
             (FEAT_SPATIAL, FEAT_SPATIAL),
             padding="VALID",
             name="fc1_conv",
@@ -101,17 +145,20 @@ class PickerFCN(nn.Module):
 def fc_params_as_conv(params: dict) -> dict:
     """Re-shape trained PickerCNN params for :class:`PickerFCN`.
 
-    ``fc1`` has kernel ``(256, 128)`` where 256 flattens a 2x2x64
+    ``fc1`` has kernel ``(4C, W)`` where ``4C`` flattens a 2x2xC
     feature window in (row, col, channel) order; the equivalent conv
-    kernel is ``(2, 2, 64, 128)``.  ``fc2`` becomes a 1x1 conv.  The
-    backbone transfers unchanged.
+    kernel is ``(2, 2, C, W)``.  ``fc2`` becomes a 1x1 conv.  The
+    backbone transfers unchanged.  Channel count is derived from the
+    kernel shape, so every ARCHS entry maps without extra metadata.
     """
     p = dict(params)
     fc1 = p.pop("fc1")
     fc2 = p.pop("fc2")
+    in_dim, width = fc1["kernel"].shape
+    channels = in_dim // (FEAT_SPATIAL * FEAT_SPATIAL)
     p["fc1_conv"] = {
         "kernel": fc1["kernel"].reshape(
-            FEAT_SPATIAL, FEAT_SPATIAL, FEAT_CHANNELS, FC_WIDTH
+            FEAT_SPATIAL, FEAT_SPATIAL, channels, width
         ),
         "bias": fc1["bias"],
     }

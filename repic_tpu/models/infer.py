@@ -37,6 +37,7 @@ from repic_tpu.models.cnn import (
     PATCH_SIZE,
     PickerCNN,
     PickerFCN,
+    arch_kwargs,
     fc_params_as_conv,
 )
 from repic_tpu.models import preprocess as pp
@@ -54,11 +55,11 @@ def score_grid_shape(shape, patch_size: int, step: int = STEP_SIZE):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("patch_size", "step", "norm")
+    jax.jit, static_argnames=("patch_size", "step", "norm", "arch")
 )
 def score_micrograph_patches(
     params, img, *, patch_size: int, step: int = STEP_SIZE,
-    norm: str = "reference",
+    norm: str = "reference", arch: str = "deep",
 ):
     """Dense sliding-window scoring via the patch classifier.
 
@@ -78,7 +79,7 @@ def score_micrograph_patches(
     H, W = img.shape
     out_h, out_w = score_grid_shape(img.shape, patch_size, step)
     row_chunk = min(ROW_CHUNK, out_h)
-    model = PickerCNN()
+    model = PickerCNN(**arch_kwargs(arch))
 
     col_starts = jnp.arange(out_w) * step
     col_idx = col_starts[:, None] + jnp.arange(patch_size)[None, :]
@@ -117,9 +118,12 @@ def score_micrograph_patches(
     return out.at[row_of_chunk.reshape(-1)].set(flat)
 
 
-@functools.partial(jax.jit, static_argnames=("patch_size", "step"))
+@functools.partial(
+    jax.jit, static_argnames=("patch_size", "step", "arch")
+)
 def score_micrograph_fcn(
-    fcn_params, img, *, patch_size: int, step: int = STEP_SIZE
+    fcn_params, img, *, patch_size: int, step: int = STEP_SIZE,
+    arch: str = "deep",
 ):
     """Fully-convolutional scoring with stride-``step`` shift filling.
 
@@ -129,7 +133,7 @@ def score_micrograph_fcn(
     Patches are resized from ``patch_size`` to 64 implicitly by
     scaling the image once (global normalization).
     """
-    model = PickerFCN()
+    model = PickerFCN(**arch_kwargs(arch))
     # Resize the whole micrograph so each patch_size window maps to a
     # 64x64 window; then the FCN scores all windows at once.
     H, W = img.shape
@@ -246,6 +250,7 @@ def pick_micrograph(
     mode: str = "patch",
     norm: str = "reference",
     step: int = STEP_SIZE,
+    arch: str = "deep",
 ):
     """Full picking pass over one raw micrograph.
 
@@ -258,7 +263,8 @@ def pick_micrograph(
     window = int(0.6 * patch_size / step)
     if mode == "fcn":
         smap = score_micrograph_fcn(
-            fc_params_as_conv(params), img, patch_size=patch_size, step=step
+            fc_params_as_conv(params), img, patch_size=patch_size,
+            step=step, arch=arch,
         )
         # FCN scoring works on the rescaled grid; its effective step
         # on the binned image is patch_size/64 * round(step*64/patch).
@@ -266,7 +272,8 @@ def pick_micrograph(
         eff_step = max(1, int(round(step * scale))) / scale
     else:
         smap = score_micrograph_patches(
-            params, img, patch_size=patch_size, step=step, norm=norm
+            params, img, patch_size=patch_size, step=step, norm=norm,
+            arch=arch,
         )
         eff_step = step
     peaks = peak_detection(np.asarray(smap), max(window, 1))

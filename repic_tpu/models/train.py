@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from repic_tpu.models.cnn import PickerCNN, fc_l2_penalty
+from repic_tpu.models.cnn import PickerCNN, arch_kwargs, fc_l2_penalty
 
 
 @dataclass
@@ -110,8 +110,12 @@ def fit(
     config: TrainConfig = TrainConfig(),
     *,
     init_params=None,
+    arch: str = "deep",
 ) -> TrainResult:
     """Train a :class:`PickerCNN`, returning the best-val params.
+
+    ``arch`` selects the filter pyramid from ``cnn.ARCHS`` (the
+    builtin ensemble's architectural-diversity knob).
 
     ``init_params`` warm-starts from an existing checkpoint (the
     reference's ``--model_retrain`` path, train.py:60-63 — each
@@ -137,7 +141,7 @@ def fit(
     )
     tx = optax.sgd(schedule, momentum=config.momentum)
 
-    model = PickerCNN()
+    model = PickerCNN(**arch_kwargs(arch))
     if init_params is None:
         jrng, init_rng = jax.random.split(jrng)
         params = model.init(

@@ -96,6 +96,7 @@ def enumerate_cliques(
     *,
     threshold: float = DEFAULT_THRESHOLD,
     max_neighbors: int = 16,
+    use_pallas: bool = False,
 ) -> CliqueSet:
     """Enumerate all k-cliques of the k-partite overlap graph.
 
@@ -106,6 +107,10 @@ def enumerate_cliques(
         box_size: scalar box edge length.
         threshold: IoU edge threshold (reference uses 0.3).
         max_neighbors: static per-pair neighbor capacity D.
+        use_pallas: neighbor search via the fused Pallas kernel
+            (:mod:`repic_tpu.ops.iou_pallas`) instead of
+            matrix + top_k — no ``(N, N)`` intermediate (interpreted
+            off-TPU, compiled on TPU).
 
     Returns:
         A :class:`CliqueSet` with capacity ``N * D**(K-1)``.
@@ -118,17 +123,28 @@ def enumerate_cliques(
     D = min(max_neighbors, N)
     sizes = _per_picker_sizes(box_size, K, xy.dtype)
 
-    # Pairwise masked IoU matrices for the anchor pairs (0, p) only;
+    # Pairwise neighbor search for the anchor pairs (0, p) only;
     # cross edges are validated elementwise from coordinates later.
     nbr_idx, nbr_iou, adj_counts = [], [], []
     for p in range(1, K):
-        iou_0p = pairwise_iou_matrix(
-            xy[0], mask[0], xy[p], mask[p], sizes[0], sizes[p]
-        )
-        # Overflow probe: the enumeration is complete iff every
-        # anchor's above-threshold neighbor count fits in D.
-        adj_counts.append(jnp.sum(iou_0p > threshold, axis=1))
-        v, i = jax.lax.top_k(iou_0p, D)  # (N, D)
+        if use_pallas:
+            from repic_tpu.ops.iou_pallas import pallas_topk_neighbors
+
+            v, i, adj = pallas_topk_neighbors(
+                xy[0], mask[0], xy[p], mask[p],
+                sizes[0], sizes[p],
+                d=D, threshold=threshold,
+                interpret=jax.default_backend() != "tpu",
+            )
+            adj_counts.append(adj)
+        else:
+            iou_0p = pairwise_iou_matrix(
+                xy[0], mask[0], xy[p], mask[p], sizes[0], sizes[p]
+            )
+            # Overflow probe: the enumeration is complete iff every
+            # anchor's above-threshold neighbor count fits in D.
+            adj_counts.append(jnp.sum(iou_0p > threshold, axis=1))
+            v, i = jax.lax.top_k(iou_0p, D)  # (N, D)
         nbr_iou.append(v)
         nbr_idx.append(i)
     max_adjacency = jnp.max(jnp.stack(adj_counts)).astype(jnp.int32)

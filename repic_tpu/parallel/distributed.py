@@ -1,0 +1,100 @@
+"""Multi-host distributed runtime (the communication-backend story).
+
+The reference has no distributed backend at all — inter-component
+communication is files on disk and env vars into subshells
+(SURVEY.md section 2c).  Here the backend is XLA's: once
+``jax.distributed`` is initialized, every jitted consensus program in
+:mod:`repic_tpu.pipeline.consensus` runs SPMD across all hosts, with
+the micrograph axis sharded over the global device mesh and the only
+collective being the output gather XLA inserts (ICI within a slice,
+DCN across hosts).  No NCCL/MPI translation — the mesh IS the
+backend.
+
+Typical multi-host launch (one process per host, standard JAX
+conventions; on Cloud TPU the coordinator fields are auto-detected):
+
+    from repic_tpu.parallel import distributed
+    distributed.initialize()            # or pass explicit fields
+    ...run the normal pipeline; meshes now span all hosts...
+
+Per-host data loading: each process reads only its shard of the
+micrograph list (``shard_for_process``), then
+``jax.make_array_from_process_local_data`` assembles the global
+batch.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+    local_device_ids=None,
+) -> bool:
+    """Initialize the JAX distributed runtime (idempotent).
+
+    Returns True when a multi-process runtime was (or already is)
+    active, False for the single-process case (no-op).  All fields
+    are optional — on managed TPU pods JAX auto-detects them; for
+    manual launches pass all three (standard ``jax.distributed``
+    semantics).
+    """
+    import jax
+
+    if jax.process_count() > 1:
+        return True  # already initialized by the launcher
+    env_np = os.environ.get("JAX_NUM_PROCESSES")
+    if num_processes is None and env_np:
+        num_processes = int(env_np)
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if not coordinator_address and (num_processes or 1) <= 1:
+        return False  # single process — nothing to do
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    return True
+
+
+def shard_for_process(items, process_id=None, process_count=None):
+    """This process's contiguous share of a global work list.
+
+    Deterministic across processes (same list in, disjoint covering
+    shards out) — the per-host data-loading half of multi-host runs.
+    """
+    import jax
+
+    pid = jax.process_index() if process_id is None else process_id
+    n = jax.process_count() if process_count is None else process_count
+    items = list(items)
+    per = -(-len(items) // n)
+    return items[pid * per : (pid + 1) * per]
+
+
+def assemble_global_batch(mesh, local_arrays, pspec=None):
+    """Build global sharded arrays from per-process local data.
+
+    ``local_arrays`` are this process's batch-leading numpy arrays
+    (its ``shard_for_process`` share, padded identically on every
+    host); returns global ``jax.Array`` views over the mesh.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from repic_tpu.parallel.mesh import MICROGRAPH_AXIS
+
+    sharding = NamedSharding(
+        mesh, pspec if pspec is not None else P(MICROGRAPH_AXIS)
+    )
+    return tuple(
+        jax.make_array_from_process_local_data(sharding, a)
+        for a in local_arrays
+    )

@@ -68,6 +68,7 @@ def consensus_one(
     spatial_grid: int | None = None,
     cell_capacity: int = 64,
     solver: str = "greedy",
+    use_pallas: bool = False,
 ) -> ConsensusResult:
     """Full consensus for one micrograph (jit/vmap-friendly).
 
@@ -98,6 +99,7 @@ def consensus_one(
             box_size,
             threshold=threshold,
             max_neighbors=max_neighbors,
+            use_pallas=use_pallas,
         )
     num_cliques = cs.num_valid
     cs = compact_cliques(cs, clique_capacity)
@@ -129,6 +131,7 @@ def make_batched_consensus(
     spatial_grid: int | None = None,
     cell_capacity: int = 64,
     solver: str = "greedy",
+    use_pallas: bool = False,
 ):
     """Build the jitted batched consensus fn, sharded over micrographs.
 
@@ -141,14 +144,14 @@ def make_batched_consensus(
     """
     return _make_batched_consensus(
         threshold, max_neighbors, clique_capacity, mesh,
-        spatial_grid, cell_capacity, solver,
+        spatial_grid, cell_capacity, solver, use_pallas,
     )
 
 
 @lru_cache(maxsize=64)
 def _make_batched_consensus(
     threshold, max_neighbors, clique_capacity, mesh,
-    spatial_grid, cell_capacity, solver="greedy",
+    spatial_grid, cell_capacity, solver="greedy", use_pallas=False,
 ):
     single = partial(
         consensus_one,
@@ -158,6 +161,7 @@ def _make_batched_consensus(
         spatial_grid=spatial_grid,
         cell_capacity=cell_capacity,
         solver=solver,
+        use_pallas=use_pallas,
     )
     batched = jax.vmap(single, in_axes=(0, 0, 0, None))
     if mesh is None:
@@ -197,6 +201,7 @@ def run_consensus_batch(
     use_mesh: bool = True,
     spatial: bool | None = None,
     solver: str = "greedy",
+    use_pallas: bool = False,
 ) -> ConsensusResult:
     """Run batched consensus on host data with automatic escalation.
 
@@ -250,6 +255,7 @@ def run_consensus_batch(
             spatial_grid=grid,
             cell_capacity=cell_cap,
             solver=solver,
+            use_pallas=use_pallas,
         )
         xy, conf, mask = batch.xy, batch.conf, batch.mask
         if mesh is not None:
@@ -332,6 +338,7 @@ def run_consensus_dir(
     use_mesh: bool = True,
     spatial: bool | None = None,
     solver: str = "greedy",
+    use_pallas: bool = False,
 ) -> dict:
     """End-to-end: read picker BOX dirs, consensus, write BOX files.
 
@@ -389,6 +396,7 @@ def run_consensus_dir(
             use_mesh=use_mesh,
             spatial=spatial,
             solver=solver,
+            use_pallas=use_pallas,
         )
         jax.block_until_ready(res.picked)
     t2 = time.time()

@@ -14,9 +14,10 @@ Two adapter families:
 * :class:`BuiltinPicker` — the in-framework JAX CNN picker; runs
   in-process (no conda, no subprocess, no GPU handoff), so a full
   iterative ensemble can run on a single TPU host.  Ensemble
-  diversity between builtin instances comes from independent init
-  seeds (the analog of the reference's three architecturally distinct
-  pickers).
+  diversity between builtin instances comes from distinct filter
+  pyramids (``cnn.ARCHS``: deep/wide/slim) plus independent init
+  seeds — the analog of the reference's three architecturally
+  distinct pickers.
 * :class:`ExternalPicker` subclasses — faithful subprocess adapters
   for SPHIRE-crYOLO, DeepPicker and Topaz, reproducing the
   reference's conda invocations; they require the corresponding
@@ -47,6 +48,7 @@ class BuiltinPicker:
     model_path: str | None = None  # current checkpoint
     threshold: float = 0.0  # run_deep.sh:26 applies 0.0
     mode: str = "patch"
+    arch: str = "deep"  # cnn.ARCHS filter pyramid
 
     def predict(self, mrc_dir: str, out_box_dir: str) -> int:
         """Pick every micrograph; returns total particles written."""
@@ -78,6 +80,7 @@ class BuiltinPicker:
                 self.particle_size,
                 mode=self.mode,
                 norm=meta.get("patch_norm", "reference"),
+                arch=meta.get("arch", self.arch),
             )
             coords = coords[coords[:, 2] >= self.threshold]
             stem = os.path.splitext(os.path.basename(path))[0]
@@ -134,6 +137,7 @@ class BuiltinPicker:
                 verbose=False,
             ),
             init_params=init_params,
+            arch=self.arch,
         )
         save_checkpoint(
             model_out,
@@ -143,6 +147,7 @@ class BuiltinPicker:
                 "patch_norm": "reference",
                 "best_val_error": result.best_val_error,
                 "picker": self.name,
+                "arch": self.arch,
             },
         )
         self.model_path = model_out
@@ -620,12 +625,17 @@ def build_pickers(config: dict) -> list:
                 if shared.endswith(".rptpu"):
                     init = shared
             model = init if init and init != "builtin" else None
+            # distinct filter pyramids per ensemble slot — the
+            # builtin analog of the reference's three structurally
+            # different pickers (overridable via <name>_arch)
+            default_arch = ("deep", "wide", "slim")[i % 3]
             pickers.append(
                 BuiltinPicker(
                     name=pname,
                     particle_size=particle_size,
                     seed=1234 + 1111 * i,
                     model_path=model,
+                    arch=config.get(f"{pname}_arch", default_arch),
                 )
             )
         elif pname == "cryolo":
