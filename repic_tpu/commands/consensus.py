@@ -59,7 +59,9 @@ def add_arguments(parser):
         "--pallas",
         action="store_true",
         help="fused Pallas neighbor-search kernel (no N x N "
-        "intermediate; interpreted off-TPU)",
+        "intermediate; interpreted off-TPU).  Dense path only: "
+        "ignored with a warning when the spatial/bucketed search "
+        "is selected (--spatial on, or auto above 4096 particles)",
     )
 
 

@@ -377,9 +377,21 @@ def _assemble_cliques_chunked(
     """
     K, N, _ = xy.shape
     a = min(anchor_chunk, N)
-    if N % a:
-        a = N
-    nc = N // a
+    # Pad the anchor axis up to a multiple of the chunk size (padded
+    # anchors carry mask False and sentinel neighbors, so they produce
+    # no cliques) — collapsing to a single full-size block here would
+    # silently reinstate the O(N * D^(K-1)) transient this path exists
+    # to avoid.
+    pad = (-N) % a
+    npad = N + pad
+    nc = npad // a
+    aid = jnp.pad(jnp.arange(N, dtype=jnp.int32), (0, pad))
+    amask = jnp.pad(mask[0], (0, pad), constant_values=False)
+    nbr_idx = [
+        jnp.pad(x, ((0, pad), (0, 0)), constant_values=N)
+        for x in nbr_idx
+    ]
+    nbr_iou = [jnp.pad(x, ((0, pad), (0, 0))) for x in nbr_iou]
     D = nbr_idx[0].shape[1]
     keep = min(clique_capacity, a * D ** (K - 1))
 
@@ -396,8 +408,8 @@ def _assemble_cliques_chunked(
     res = jax.lax.map(
         one,
         (
-            jnp.arange(N, dtype=jnp.int32).reshape(nc, a),
-            mask[0].reshape(nc, a),
+            aid.reshape(nc, a),
+            amask.reshape(nc, a),
             tuple(x.reshape(nc, a, D) for x in nbr_idx),
             tuple(x.reshape(nc, a, D) for x in nbr_iou),
         ),

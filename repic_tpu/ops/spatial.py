@@ -222,9 +222,16 @@ def bucketed_topk_neighbors(
     """
     n = xy_a.shape[0]
     c = min(chunk, n)
-    if n % c:
-        c = n  # fall back to a single block for odd sizes
-    n_chunks = n // c
+    # Pad the anchor axis to a multiple of the chunk size (padded
+    # anchors are masked out and contribute nothing) — a single
+    # full-size block for odd N would defeat the memory bound.
+    pad = (-n) % c
+    ij_a = bt_a.cell_ij
+    if pad:
+        xy_a = jnp.pad(xy_a, ((0, pad), (0, 0)))
+        mask_a = jnp.pad(mask_a, (0, pad), constant_values=False)
+        ij_a = jnp.pad(ij_a, ((0, pad), (0, 0)))
+    n_chunks = (n + pad) // c
     d = min(d, 9 * bt_b.capacity)
 
     sb = size_a if size_b is None else size_b
@@ -239,18 +246,18 @@ def bucketed_topk_neighbors(
         return v, jnp.take_along_axis(idx_c, s, axis=1), adj
 
     if n_chunks == 1:
-        v, i, adj = one((xy_a, mask_a, bt_a.cell_ij))
+        v, i, adj = one((xy_a[:n], mask_a[:n], ij_a[:n]))
         return v, i, adj
     v, i, adj = jax.lax.map(
         one,
         (
             xy_a.reshape(n_chunks, c, 2),
             mask_a.reshape(n_chunks, c),
-            bt_a.cell_ij.reshape(n_chunks, c, 2),
+            ij_a.reshape(n_chunks, c, 2),
         ),
     )
     return (
-        v.reshape(n, d),
-        i.reshape(n, d),
-        adj.reshape(n),
+        v.reshape(n + pad, d)[:n],
+        i.reshape(n + pad, d)[:n],
+        adj.reshape(n + pad)[:n],
     )

@@ -44,8 +44,10 @@ def initialize(
     """
     import jax
 
-    if jax.process_count() > 1:
-        return True  # already initialized by the launcher
+    # Multi-process intent is decided from args/env ONLY — calling
+    # jax.process_count() here would initialize the XLA backend, after
+    # which jax.distributed.initialize refuses to run ("must be called
+    # before any JAX calls that might initialise the XLA backend").
     env_np = os.environ.get("JAX_NUM_PROCESSES")
     if num_processes is None and env_np:
         num_processes = int(env_np)
@@ -55,12 +57,21 @@ def initialize(
         process_id = int(os.environ["JAX_PROCESS_ID"])
     if not coordinator_address and (num_processes or 1) <= 1:
         return False  # single process — nothing to do
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids,
-    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+    except RuntimeError:
+        # Either the launcher already initialized the runtime (fine:
+        # idempotent success) or backends were initialized before us
+        # (unrecoverable: re-raise).  process_count() is safe to call
+        # now — the failed initialize means backends are already up.
+        if jax.process_count() > 1:
+            return True
+        raise
     return True
 
 

@@ -217,6 +217,17 @@ def run_consensus_batch(
     mesh = consensus_mesh() if use_mesh else None
     if spatial is None:
         spatial = batch.capacity > SPATIAL_THRESHOLD
+    if spatial and use_pallas:
+        import warnings
+
+        warnings.warn(
+            "the Pallas neighbor-search kernel applies to the dense "
+            "all-pairs path only; this batch selected the spatial "
+            "(bucketed) path — auto-enabled above "
+            f"{SPATIAL_THRESHOLD} particles — so --pallas is ignored",
+            stacklevel=2,
+        )
+        use_pallas = False
     # box_size may be a scalar or one size per picker (mixed-size
     # ensembles); spatial hashing always uses the largest.
     sizes = np.asarray(box_size, np.float32)

@@ -1,0 +1,94 @@
+"""Worker process for the two-process distributed test.
+
+Launched twice by tests/test_distributed.py with
+``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+``JAX_PROCESS_ID`` in the environment.  Each worker initializes the
+JAX distributed runtime on the CPU backend (one local device per
+process), loads only its own half of the deterministic global
+workload, assembles the global sharded batch, runs the jitted
+consensus over the 2-device global mesh, and writes its addressable
+output shard for the parent test to verify against a single-process
+run.
+"""
+
+import json
+import os
+import re
+import sys
+
+
+def main():
+    out_dir = sys.argv[1]
+
+    # One plain CPU device per process: scrub any virtual-device-count
+    # flag inherited from the test conftest, force the CPU platform
+    # (env alone can be overridden by sitecustomize — the config API
+    # wins), and skip the persistent AOT cache.
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", flags
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("REPIC_TPU_NO_CACHE", "1")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from repic_tpu.parallel import distributed
+
+    assert distributed.initialize() is True, "expected multi-process"
+    # idempotent second call: the runtime is already up
+    assert distributed.initialize() is True
+
+    import numpy as np
+
+    from repic_tpu.parallel.mesh import consensus_mesh
+    from repic_tpu.pipeline.consensus import make_batched_consensus
+
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 2  # one CPU device per process
+    pid = jax.process_index()
+
+    # Deterministic global workload — both workers derive the same
+    # arrays, then keep only their own contiguous shard.
+    m, k, n = 4, 3, 32
+    rng = np.random.default_rng(0)
+    xy = rng.uniform(50, 900, size=(m, k, n, 2)).astype(np.float32)
+    conf = rng.uniform(0.05, 1.0, size=(m, k, n)).astype(np.float32)
+    mask = np.ones((m, k, n), bool)
+
+    rows = distributed.shard_for_process(list(range(m)))
+    mesh = consensus_mesh()
+    gxy, gconf, gmask = distributed.assemble_global_batch(
+        mesh, (xy[rows], conf[rows], mask[rows])
+    )
+    assert gxy.shape == (m, k, n, 2)  # global view, locally sharded
+
+    fn = make_batched_consensus(
+        max_neighbors=8, clique_capacity=128, mesh=mesh
+    )
+    res = fn(gxy, gconf, gmask, 180.0)
+    jax.block_until_ready(res.picked)
+
+    shards = sorted(
+        res.picked.addressable_shards,
+        key=lambda s: s.index[0].start or 0,
+    )
+    picked = np.concatenate([np.asarray(s.data) for s in shards])
+    w_shards = sorted(
+        res.w.addressable_shards, key=lambda s: s.index[0].start or 0
+    )
+    w = np.concatenate([np.asarray(s.data) for s in w_shards])
+    np.savez(
+        os.path.join(out_dir, f"proc{pid}.npz"),
+        picked=picked,
+        w=w,
+        rows=np.asarray(rows),
+    )
+    with open(os.path.join(out_dir, f"proc{pid}.json"), "w") as f:
+        json.dump({"ok": True, "pid": pid}, f)
+
+
+if __name__ == "__main__":
+    main()

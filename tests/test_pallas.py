@@ -159,3 +159,27 @@ def test_batched_pipeline_with_pallas(tmp_path):
             if p
         }
         assert a == b
+
+
+def test_pallas_ignored_on_spatial_path_warns():
+    """--pallas + spatial path: warn (ADVICE r1), never silently drop."""
+    import pytest
+
+    from repic_tpu.parallel.batching import pad_batch
+    from repic_tpu.pipeline.consensus import run_consensus_batch
+    from repic_tpu.utils.box_io import BoxSet
+
+    rng = np.random.default_rng(21)
+    sets = [
+        BoxSet(
+            xy=rng.uniform(0, 2000, size=(60, 2)).astype(np.float32),
+            conf=rng.uniform(0.1, 1, 60).astype(np.float32),
+            wh=np.full((60, 2), BOX, np.float32),
+        )
+        for _ in range(3)
+    ]
+    batch = pad_batch([("m0", sets)])
+    with pytest.warns(UserWarning, match="Pallas.*ignored|ignored"):
+        run_consensus_batch(
+            batch, BOX, use_mesh=False, spatial=True, use_pallas=True
+        )

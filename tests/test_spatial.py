@@ -212,6 +212,59 @@ def test_chunked_assembly_matches_dense():
         np.testing.assert_allclose(dw[key], cw[key], rtol=1e-5)
 
 
+def test_chunked_assembly_non_divisible_anchor_count():
+    """N not divisible by anchor_chunk must pad to chunk multiples
+    (NOT collapse to one full-size block) and still match dense."""
+    rng = np.random.default_rng(13)
+    xy, conf, mask = _random_micrograph(rng, k=3, n=100)
+    g = grid_size(4000 + BOX, BOX)
+    dense = enumerate_cliques(xy, conf, mask, BOX, max_neighbors=8)
+    chunked = enumerate_cliques_bucketed(
+        xy, conf, mask, BOX, max_neighbors=8, grid=g,
+        cell_capacity=32, clique_capacity=512, anchor_chunk=16,
+    )
+    assert int(chunked.num_valid) == int(dense.num_valid)
+    assert _clique_key_set(dense) == _clique_key_set(chunked)
+
+
+def test_bucketed_topk_non_divisible_chunk():
+    """Anchor count not divisible by the streaming chunk size."""
+    from repic_tpu.ops.spatial import bucketed_topk_neighbors
+
+    rng = np.random.default_rng(14)
+    n = 130
+    xa = jnp.asarray(rng.uniform(0, 1500, size=(n, 2)), jnp.float32)
+    xb = xa + jnp.asarray(rng.normal(0, 40, size=(n, 2)), jnp.float32)
+    ma = jnp.ones(n, bool)
+    g = grid_size(1500 + BOX, BOX)
+    bta = bucket_particles(xa, ma, BOX, grid=g, cell_capacity=32)
+    btb = bucket_particles(xb, ma, BOX, grid=g, cell_capacity=32)
+    v1, i1, adj1 = bucketed_topk_neighbors(
+        xa, ma, bta, xb, ma, btb, BOX, threshold=0.3, d=8, chunk=48
+    )
+    v2, i2, adj2 = bucketed_topk_neighbors(
+        xa, ma, bta, xb, ma, btb, BOX, threshold=0.3, d=8, chunk=n
+    )
+    assert v1.shape == (n, 8)
+    np.testing.assert_allclose(
+        np.asarray(v1), np.asarray(v2), atol=1e-6
+    )
+    np.testing.assert_array_equal(np.asarray(adj1), np.asarray(adj2))
+    # indices may tie-permute within equal IoUs; compare value-sets
+    for r in range(n):
+        s1 = {
+            (int(i), round(float(x), 5))
+            for i, x in zip(np.asarray(i1[r]), np.asarray(v1[r]))
+            if x > 0
+        }
+        s2 = {
+            (int(i), round(float(x), 5))
+            for i, x in zip(np.asarray(i2[r]), np.asarray(v2[r]))
+            if x > 0
+        }
+        assert s1 == s2
+
+
 def test_chunked_capacity_overflow_visible():
     """When clique_capacity is too small, num_valid still reports the
     true count so escalation triggers."""
