@@ -1,0 +1,134 @@
+#!/usr/bin/env python3
+"""Stress benchmark: BASELINE configs[3] — 50k particles x 4 pickers.
+
+Synthesizes a dense-field workload of ``--n`` particles per picker
+per micrograph (default 50,000; cluster-structured like real picks:
+one jittered detection per true particle per picker) and runs the
+bucketed + anchor-chunked consensus path on batches of ``--m``
+micrographs, reporting steady-state micrographs/sec and the
+extrapolated time for the full 128-micrograph stress config.
+
+Not driver-run (bench.py is the single-line headline benchmark);
+results are recorded in docs/tpu.md.  Prints one JSON line per
+measurement plus a final summary line.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def synthesize(m, k, n, seed=0, spacing=150.0, jitter=10.0):
+    """Cluster-structured dense field: ~n true particles on a jittered
+    grid; each picker reports each particle once with jitter."""
+    rng = np.random.default_rng(seed)
+    side = int(np.ceil(np.sqrt(n)))
+    gx, gy = np.meshgrid(np.arange(side), np.arange(side))
+    base = (
+        np.stack([gx, gy], -1).reshape(-1, 2)[:n].astype(np.float32)
+        * spacing
+        + spacing
+    )
+    xy = np.stack(
+        [
+            np.stack(
+                [
+                    base
+                    + rng.normal(0, jitter, base.shape).astype(np.float32)
+                    for _ in range(k)
+                ]
+            )
+            for _ in range(m)
+        ]
+    )  # (m, k, n, 2)
+    conf = rng.uniform(0.05, 1.0, size=(m, k, n)).astype(np.float32)
+    mask = np.ones((m, k, n), bool)
+    return xy, conf, mask
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=50_000)
+    ap.add_argument("--k", type=int, default=4)
+    ap.add_argument("--m", type=int, default=8, help="micrographs/batch")
+    ap.add_argument("--total", type=int, default=128)
+    ap.add_argument("--box", type=float, default=180.0)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+
+    if args.cpu:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from repic_tpu.parallel.batching import PaddedBatch
+    from repic_tpu.pipeline.consensus import run_consensus_batch
+
+    platform = jax.devices()[0].platform
+    print(f"platform: {platform}", file=sys.stderr)
+
+    xy, conf, mask = synthesize(args.m, args.k, args.n)
+    batch = PaddedBatch(
+        xy=xy,
+        conf=conf,
+        mask=mask,
+        names=tuple(f"m{i}" for i in range(args.m)),
+        counts=np.full((args.m, args.k), args.n, np.int32),
+    )
+
+    t0 = time.time()
+    res = run_consensus_batch(batch, args.box, use_mesh=False)
+    jax.block_until_ready(res.picked)
+    first = time.time() - t0
+    n_cliques = int(np.sum(np.asarray(res.num_cliques)))
+    n_picked = int(np.asarray(res.picked).sum())
+    print(
+        json.dumps(
+            {
+                "metric": "stress first-call (incl. compile+escalation)",
+                "seconds": round(first, 2),
+                "cliques": n_cliques,
+                "picked": n_picked,
+            }
+        )
+    )
+
+    # steady state: same shapes, fresh data (no escalation re-compile)
+    times = []
+    for rep in range(3):
+        xy2, conf2, mask2 = synthesize(args.m, args.k, args.n, seed=rep + 1)
+        b2 = batch._replace(xy=xy2, conf=conf2, mask=mask2)
+        t0 = time.time()
+        r2 = run_consensus_batch(b2, args.box, use_mesh=False)
+        jax.block_until_ready(r2.picked)
+        times.append(time.time() - t0)
+    steady = min(times)
+    rate = args.m / steady
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"dense-field stress consensus ({args.n} particles x "
+                    f"{args.k} pickers), steady-state"
+                ),
+                "value": round(rate, 3),
+                "unit": "micrographs/sec",
+                "platform": platform,
+                "batch_s": round(steady, 3),
+                "extrapolated_128_micrographs_s": round(
+                    args.total / rate, 1
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

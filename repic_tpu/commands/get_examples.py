@@ -4,9 +4,12 @@ Parity with the reference's Bash fetcher (reference:
 repic/iterative_particle_picking/get_examples.sh): downloads 32 T20S
 proteasome micrographs plus normative particle BOX files from the
 REPIC public S3 bucket, for use with ``iter_pick``.  Implemented with
-urllib (no wget/curl dependency), resumable (existing complete files
-are skipped), and degrades with a clear message in offline
-environments.
+urllib (no wget/curl dependency), over HTTPS with per-file integrity
+verification (received bytes must be non-empty and match the
+Content-Length the server declares — a truncated or tampered transfer
+is rejected, not silently accepted), resumable (existing non-empty
+files are skipped unless ``--force``), and degrades with a clear
+message in offline environments.
 """
 
 from __future__ import annotations
@@ -19,7 +22,7 @@ import urllib.request
 
 name = "get_examples"
 
-BUCKET = "http://org.gersteinlab.repic.s3.amazonaws.com/example_data_10057"
+BUCKET = "https://org.gersteinlab.repic.s3.amazonaws.com/example_data_10057"
 
 # 32 EMPIAR-10057 micrograph stems (get_examples.sh:24)
 FILE_STEMS = (
@@ -42,11 +45,31 @@ def add_arguments(parser) -> None:
         "--timeout", type=float, default=60.0,
         help="per-file download timeout (seconds)",
     )
+    parser.add_argument(
+        "--force", action="store_true",
+        help="re-download files that already exist",
+    )
+
+
+class IntegrityError(OSError):
+    """Downloaded bytes do not match what the server declared."""
 
 
 def _fetch(url: str, dst: str, timeout: float) -> int:
     with urllib.request.urlopen(url, timeout=timeout) as r:
+        declared = r.headers.get("Content-Length")
         data = r.read()
+    if not data:
+        raise IntegrityError(f"empty response for {url}")
+    try:
+        expected = int(declared) if declared is not None else None
+    except ValueError:  # non-numeric header from a proxy/portal
+        expected = None
+    if expected is not None and len(data) != expected:
+        raise IntegrityError(
+            f"truncated download for {url}: got {len(data)} bytes, "
+            f"server declared {declared}"
+        )
     tmp = dst + ".part"
     with open(tmp, "wb") as f:
         f.write(data)
@@ -60,7 +83,11 @@ def main(args) -> None:
     for stem in FILE_STEMS:
         for ext in (".mrc", ".box"):
             dst = os.path.join(args.out_dir, stem + ext)
-            if os.path.exists(dst) and os.path.getsize(dst) > 0:
+            if (
+                not getattr(args, "force", False)
+                and os.path.exists(dst)
+                and os.path.getsize(dst) > 0
+            ):
                 skipped += 1
                 continue
             url = f"{BUCKET}/{stem}{ext}"

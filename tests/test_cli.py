@@ -196,3 +196,87 @@ def test_fused_consensus_writes_runtime_tsv(tmp_path, rng):
         line.split("\t") for line in tsv.read_text().splitlines()
     )
     assert {"load", "compute", "write"} <= set(stages)
+
+
+def test_get_examples_rejects_truncated_download(tmp_path, monkeypatch):
+    """Integrity check (ADVICE r1): a response shorter than the
+    declared Content-Length must be rejected, not written."""
+    import io
+
+    from repic_tpu.commands import get_examples
+
+    class FakeResponse(io.BytesIO):
+        headers = {"Content-Length": "100"}
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    monkeypatch.setattr(
+        get_examples.urllib.request,
+        "urlopen",
+        lambda url, timeout=None: FakeResponse(b"short"),
+    )
+    import pytest as _pytest
+
+    with _pytest.raises(get_examples.IntegrityError, match="truncated"):
+        get_examples._fetch(
+            "https://example/x.mrc", str(tmp_path / "x.mrc"), 5.0
+        )
+    assert not (tmp_path / "x.mrc").exists()
+
+
+def test_get_examples_rejects_empty_download(tmp_path, monkeypatch):
+    import io
+
+    from repic_tpu.commands import get_examples
+
+    class FakeResponse(io.BytesIO):
+        headers = {}
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    monkeypatch.setattr(
+        get_examples.urllib.request,
+        "urlopen",
+        lambda url, timeout=None: FakeResponse(b""),
+    )
+    import pytest as _pytest
+
+    with _pytest.raises(get_examples.IntegrityError, match="empty"):
+        get_examples._fetch(
+            "https://example/x.mrc", str(tmp_path / "x.mrc"), 5.0
+        )
+
+
+def test_get_examples_accepts_matching_length(tmp_path, monkeypatch):
+    import io
+
+    from repic_tpu.commands import get_examples
+
+    class FakeResponse(io.BytesIO):
+        headers = {"Content-Length": "5"}
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    monkeypatch.setattr(
+        get_examples.urllib.request,
+        "urlopen",
+        lambda url, timeout=None: FakeResponse(b"hello"),
+    )
+    n = get_examples._fetch(
+        "https://example/x.box", str(tmp_path / "x.box"), 5.0
+    )
+    assert n == 5
+    assert (tmp_path / "x.box").read_bytes() == b"hello"
+    assert get_examples.BUCKET.startswith("https://")

@@ -1,0 +1,81 @@
+"""End-to-end consensus on the committed fixture set.
+
+Unlike tests/test_golden_10017.py (which needs the reference mount),
+this runs against ``tests/fixtures/mini10017/`` — a committed,
+deterministically synthesized 3-picker x 3-micrograph dataset — so
+golden-style coverage survives without any external data.  The
+expected snapshot was produced by tests/fixtures/make_fixture.py.
+"""
+
+import json
+import os
+
+import numpy as np
+
+from repic_tpu.ops.solver import solve_exact_py
+from repic_tpu.pipeline.consensus import run_consensus_dir
+from repic_tpu.utils import box_io
+
+HERE = os.path.join(os.path.dirname(__file__), "fixtures")
+FIXTURE = os.path.join(HERE, "mini10017")
+EXPECTED = os.path.join(HERE, "mini10017_expected.json")
+
+
+def test_fixture_consensus_matches_snapshot(tmp_path):
+    with open(EXPECTED) as f:
+        expected = json.load(f)
+    out = str(tmp_path / "out")
+    stats = run_consensus_dir(
+        FIXTURE, out, expected["box_size"], use_mesh=False
+    )
+    assert sorted(stats["pickers"]) == expected["pickers"]
+    assert stats["num_cliques"] == expected["num_cliques"]
+    assert stats["particle_counts"] == expected["particle_counts"]
+    for name, count in expected["particle_counts"].items():
+        rows = open(os.path.join(out, name + ".box")).read().splitlines()
+        assert len(rows) == count
+        weights = [float(r.split("\t")[4]) for r in rows]
+        assert weights == sorted(weights, reverse=True)
+
+
+def test_fixture_solver_within_gate_of_exact(tmp_path):
+    """The committed fixture also gates the solver against the exact
+    oracle, mirroring the reference-mount golden test."""
+    from repic_tpu.parallel.batching import pad_batch
+    from repic_tpu.pipeline.consensus import run_consensus_batch
+
+    with open(EXPECTED) as f:
+        expected = json.load(f)
+    pickers = box_io.discover_picker_dirs(FIXTURE)
+    names = box_io.micrograph_names(os.path.join(FIXTURE, pickers[0]))
+    loaded = [
+        (n, box_io.load_micrograph_set(FIXTURE, pickers, n))
+        for n in names
+    ]
+    batch = pad_batch(loaded)
+    res = run_consensus_batch(
+        batch, float(expected["box_size"]), use_mesh=False
+    )
+    k = len(pickers)
+    for i in range(len(names)):
+        valid = np.asarray(res.valid[i])
+        mem = np.asarray(res.member_idx[i])[valid]
+        w = np.asarray(res.w[i])[valid]
+        picked = np.asarray(res.picked[i])[valid]
+        vid = mem + np.arange(k)[None, :] * batch.capacity
+        exact = solve_exact_py(vid, w.astype(np.float64))
+        assert w[picked].sum() >= 0.98 * w[exact].sum()
+
+
+def test_fixture_sigmoid_path_exercised():
+    """The gamma picker stores log-likelihood confidences; loading
+    must sigmoid them into (0, 1) (reference common.py:92-94)."""
+    bs = box_io.read_box(
+        os.path.join(FIXTURE, "gamma", "mic_000.box")
+    )
+    raw = np.loadtxt(
+        os.path.join(FIXTURE, "gamma", "mic_000.box"), usecols=4
+    )
+    assert (raw < 0).any()  # file really holds log-likelihoods
+    assert (np.asarray(bs.conf) > 0).all()
+    assert (np.asarray(bs.conf) < 1).all()

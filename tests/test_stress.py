@@ -1,0 +1,85 @@
+"""Scaled-down stress-config test (BASELINE configs[3] shape).
+
+The real config is 50k particles x 4 pickers x 128 micrographs
+(exercised on hardware by bench_stress.py; results in docs/tpu.md).
+Here the same code path — auto-selected spatial bucketing, capacity
+probe, anchor-chunked assembly — runs at 5k particles on the CPU mesh
+and is validated against the dense all-pairs path.
+"""
+
+import numpy as np
+import pytest
+
+from repic_tpu.parallel.batching import PaddedBatch
+from repic_tpu.pipeline.consensus import (
+    SPATIAL_THRESHOLD,
+    run_consensus_batch,
+)
+
+N = 5000
+K = 4
+BOX = 180.0
+
+
+@pytest.fixture(scope="module")
+def stress_batch():
+    assert N > SPATIAL_THRESHOLD  # auto-selects the bucketed path
+    rng = np.random.default_rng(33)
+    side = int(np.ceil(np.sqrt(N)))
+    gx, gy = np.meshgrid(np.arange(side), np.arange(side))
+    base = (
+        np.stack([gx, gy], -1).reshape(-1, 2)[:N].astype(np.float32)
+        * 150.0
+        + 150.0
+    )
+    xy = np.stack(
+        [
+            base + rng.normal(0, 10, base.shape).astype(np.float32)
+            for _ in range(K)
+        ]
+    )[None]
+    conf = rng.uniform(0.05, 1.0, size=(1, K, N)).astype(np.float32)
+    mask = np.ones((1, K, N), bool)
+    return PaddedBatch(
+        xy=xy,
+        conf=conf,
+        mask=mask,
+        names=("m0",),
+        counts=np.full((1, K), N, np.int32),
+    )
+
+
+@pytest.mark.slow
+def test_auto_spatial_matches_dense_at_stress_scale(stress_batch):
+    auto = run_consensus_batch(stress_batch, BOX, use_mesh=False)
+    dense = run_consensus_batch(
+        stress_batch, BOX, use_mesh=False, spatial=False
+    )
+    assert int(np.asarray(auto.num_cliques).sum()) == int(
+        np.asarray(dense.num_cliques).sum()
+    )
+    ak = {
+        tuple(m)
+        for m, p in zip(
+            np.asarray(auto.member_idx[0]), np.asarray(auto.picked[0])
+        )
+        if p
+    }
+    dk = {
+        tuple(m)
+        for m, p in zip(
+            np.asarray(dense.member_idx[0]), np.asarray(dense.picked[0])
+        )
+        if p
+    }
+    assert ak == dk
+    assert len(ak) > 0.9 * N  # nearly every true particle recovered
+
+
+@pytest.mark.slow
+def test_stress_feasibility_and_counts(stress_batch):
+    res = run_consensus_batch(stress_batch, BOX, use_mesh=False)
+    picked = np.asarray(res.picked[0])
+    mem = np.asarray(res.member_idx[0])[picked]
+    used = [(p, int(row[p])) for row in mem for p in range(K)]
+    assert len(used) == len(set(used))  # no particle reused

