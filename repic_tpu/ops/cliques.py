@@ -122,6 +122,12 @@ def enumerate_cliques(
         )
     D = min(max_neighbors, N)
     sizes = _per_picker_sizes(box_size, K, xy.dtype)
+    if use_pallas and D >= 128:
+        # the Pallas kernel's top-D state is one 128-lane block; the
+        # capacity-escalation loop can legitimately push D past it on
+        # pathological data — fall back to the XLA matrix path rather
+        # than crash mid-escalation
+        use_pallas = False
 
     # Pairwise neighbor search for the anchor pairs (0, p) only;
     # cross edges are validated elementwise from coordinates later.

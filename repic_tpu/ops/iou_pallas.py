@@ -12,9 +12,18 @@ repic/commands/get_cliques.py:59-69):
         running top-D  = select_D(concat(top-D, iou))   per anchor
 
 The ``(N, M)`` matrix never exists; per-step state is ``(TM, TN)`` in
-VMEM plus the ``(TM, D)`` running top-D written to the revisited
+VMEM plus the ``(TM, LANE)`` running top-D written to the revisited
 output block — the classic TPU accumulation pattern (outputs indexed
 by ``i`` only are revisited across the sequential ``j`` steps).
+
+Memory layout is (8, 128)-tile aligned: every block's trailing (lane)
+dimension is a multiple of 128 — the anchor-side x/y/mask are packed
+into one ``(TM, 128)`` block (columns 0..2), the running top-D state
+and outputs are ``(TM, 128)`` with the first ``D`` lanes meaningful,
+and candidate tiles are ``(1, TN)`` with ``TN`` a multiple of 128.
+(The original layout used (TM, 1)/(TM, D) blocks, which relied on
+implicit lane padding the TPU lowering does not guarantee — ADVICE
+round 1.)
 
 The top-D merge is D unrolled select-max passes on the VPU (no sort,
 no lax.top_k): each pass takes the row max, extracts its index with a
@@ -25,7 +34,8 @@ Used by :func:`pallas_topk_neighbors`, a drop-in for the dense path's
 neighbor search (same contract as the bucketed
 ``bucketed_topk_neighbors``: values, candidate indices with sentinel
 ``M`` for empty slots, and the per-anchor adjacency count probe).
-Runs in interpreter mode on CPU (tests) and compiled on TPU.
+Runs in interpreter mode on CPU (tests) and compiled on TPU
+(smoke-tested behind the ``tpu`` marker, tests/test_pallas.py).
 """
 
 from __future__ import annotations
@@ -37,26 +47,36 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 NEG = -1.0  # sentinel value for empty top-D slots (any IoU is >= 0)
+LANE = 128  # TPU lane width; all trailing block dims align to this
 
 
 def _neighbor_kernel(
-    size_ref, ax_ref, ay_ref, am_ref, bx_ref, by_ref, bm_ref,
-    tv_ref, ti_ref, cnt_ref,
+    size_ref, a_ref, bx_ref, by_ref, bm_ref,
+    tv_ref, ti_ref,
     *, d: int, tn: int, threshold: float, m_total: int,
 ):
     j = pl.program_id(1)
     sa = size_ref[0]
     sb = size_ref[1]
+    tm = tv_ref.shape[0]
 
     @pl.when(j == 0)
     def _init():
         tv_ref[:] = jnp.full(tv_ref.shape, NEG, tv_ref.dtype)
-        ti_ref[:] = jnp.full(ti_ref.shape, m_total, ti_ref.dtype)
-        cnt_ref[:] = jnp.zeros(cnt_ref.shape, cnt_ref.dtype)
+        # lanes 0..d-1: top-D indices (sentinel); lane d: running
+        # adjacency count (0); rest: sentinel filler
+        ti_ref[:] = jnp.concatenate(
+            [
+                jnp.full((tm, d), m_total, ti_ref.dtype),
+                jnp.zeros((tm, 1), ti_ref.dtype),
+                jnp.full((tm, LANE - d - 1), m_total, ti_ref.dtype),
+            ],
+            axis=1,
+        )
 
-    ax = ax_ref[:]                      # (TM, 1)
-    ay = ay_ref[:]
-    am = am_ref[:]
+    ax = a_ref[:, 0:1]                  # (TM, 1) lane slices of the
+    ay = a_ref[:, 1:2]                  # packed (TM, 128) anchor block
+    am = a_ref[:, 2:3]
     bx = bx_ref[:]                      # (1, TN)
     by = by_ref[:]
     bm = bm_ref[:]
@@ -73,18 +93,19 @@ def _neighbor_kernel(
     valid = (am > 0.0) & (bm > 0.0)
     iou = jnp.where(valid, iou, NEG)    # (TM, TN)
 
-    cnt_ref[:] += jnp.sum(
-        (iou > threshold).astype(cnt_ref.dtype), axis=1, keepdims=True
+    tile_cnt = jnp.sum(
+        (iou > threshold).astype(jnp.int32), axis=1, keepdims=True
     )
+    cnt = ti_ref[:, d : d + 1] + tile_cnt            # (TM, 1)
 
     # Merge this tile into the running top-D: D unrolled
     # select-max-and-mask passes over the (TM, D + TN) workspace.
     cand_idx = j * tn + jax.lax.broadcasted_iota(
         jnp.int32, iou.shape, 1
     )
-    work_v = jnp.concatenate([tv_ref[:], iou], axis=1)
+    work_v = jnp.concatenate([tv_ref[:, :d], iou], axis=1)
     work_i = jnp.concatenate(
-        [ti_ref[:], cand_idx.astype(jnp.int32)], axis=1
+        [ti_ref[:, :d], cand_idx.astype(jnp.int32)], axis=1
     )
     pos = jax.lax.broadcasted_iota(jnp.int32, work_v.shape, 1)
     new_v = []
@@ -103,6 +124,9 @@ def _neighbor_kernel(
         new_v.append(row_max)
         new_i.append(picked_i)
         work_v = jnp.where(sel, NEG, work_v)
+    new_v.append(jnp.full((tm, LANE - d), NEG, tv_ref.dtype))
+    new_i.append(cnt)  # the count rides in lane d
+    new_i.append(jnp.full((tm, LANE - d - 1), m_total, jnp.int32))
     tv_ref[:] = jnp.concatenate(new_v, axis=1)
     ti_ref[:] = jnp.concatenate(new_i, axis=1)
 
@@ -142,17 +166,35 @@ def pallas_topk_neighbors(
     """
     from jax.experimental.pallas import tpu as pltpu
 
+    if d >= LANE:
+        # the top-D state and the adjacency count share one 128-lane
+        # block; callers needing d >= 128 use the XLA matrix path
+        # (enumerate_cliques falls back automatically)
+        raise ValueError(f"d={d} needs the XLA path (limit {LANE - 1})")
     n, m = xy_a.shape[0], xy_b.shape[0]
-    tm = min(tile_m, n)
-    tn = min(tile_n, m)
+    if n == 0 or m == 0:
+        return (
+            jnp.full((n, d), NEG, xy_a.dtype),
+            jnp.full((n, d), m, jnp.int32),
+            jnp.zeros((n,), jnp.int32),
+        )
+    # tiles rounded UP to the (8, 128) TPU tile so caller-supplied
+    # sizes can never reintroduce an unaligned layout
+    tm = min(-(-tile_m // 8) * 8, -(-n // 8) * 8)
+    tn = min(-(-tile_n // LANE) * LANE, -(-m // LANE) * LANE)
     # pad to tile multiples with masked slots
     n_pad = -n % tm
     m_pad = -m % tn
-    ax = jnp.pad(xy_a[:, 0], (0, n_pad)).reshape(-1, 1)
-    ay = jnp.pad(xy_a[:, 1], (0, n_pad)).reshape(-1, 1)
-    am = jnp.pad(
-        mask_a.astype(jnp.float32), (0, n_pad)
-    ).reshape(-1, 1)
+    # anchor-side packed block: lanes 0..2 = x, y, mask
+    a_pack = jnp.stack(
+        [
+            jnp.pad(xy_a[:, 0], (0, n_pad)),
+            jnp.pad(xy_a[:, 1], (0, n_pad)),
+            jnp.pad(mask_a.astype(xy_a.dtype), (0, n_pad)),
+        ],
+        axis=1,
+    )
+    a_pack = jnp.pad(a_pack, ((0, 0), (0, LANE - 3)))
     bx = jnp.pad(xy_b[:, 0], (0, m_pad)).reshape(1, -1)
     by = jnp.pad(xy_b[:, 1], (0, m_pad)).reshape(1, -1)
     bm = jnp.pad(
@@ -174,28 +216,24 @@ def pallas_topk_neighbors(
         m_total=m,
     )
     grid = (np_ // tm, mp // tn)
-    tv, ti, cnt = pl.pallas_call(
+    tv, ti = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((tm, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((tm, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((tm, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((tm, LANE), lambda i, j: (i, 0)),
             pl.BlockSpec((1, tn), lambda i, j: (0, j)),
             pl.BlockSpec((1, tn), lambda i, j: (0, j)),
             pl.BlockSpec((1, tn), lambda i, j: (0, j)),
         ],
         out_specs=[
-            pl.BlockSpec((tm, d), lambda i, j: (i, 0)),
-            pl.BlockSpec((tm, d), lambda i, j: (i, 0)),
-            pl.BlockSpec((tm, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((tm, LANE), lambda i, j: (i, 0)),
+            pl.BlockSpec((tm, LANE), lambda i, j: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((np_, d), xy_a.dtype),
-            jax.ShapeDtypeStruct((np_, d), jnp.int32),
-            jax.ShapeDtypeStruct((np_, 1), jnp.int32),
+            jax.ShapeDtypeStruct((np_, LANE), xy_a.dtype),
+            jax.ShapeDtypeStruct((np_, LANE), jnp.int32),
         ],
         interpret=interpret,
-    )(sizes, ax, ay, am, bx, by, bm)
-    return tv[:n], ti[:n], cnt[:n, 0]
+    )(sizes, a_pack, bx, by, bm)
+    return tv[:n, :d], ti[:n, :d], ti[:n, d]

@@ -7,9 +7,18 @@ simulation).  This must run before anything imports jax.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# REPIC_TPU_TEST_TPU=1 opts out of the CPU forcing so the @pytest.mark
+# .tpu smoke tests (compiled Pallas) can reach the real chip:
+#     REPIC_TPU_TEST_TPU=1 pytest -m tpu tests/test_pallas.py
+_USE_REAL_TPU = os.environ.get("REPIC_TPU_TEST_TPU") == "1"
+
+if not _USE_REAL_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
+if (
+    not _USE_REAL_TPU
+    and "xla_force_host_platform_device_count" not in _flags
+):
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
@@ -19,7 +28,8 @@ if "xla_force_host_platform_device_count" not in _flags:
 # is too late — force the platform via the config API as well.
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if not _USE_REAL_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
