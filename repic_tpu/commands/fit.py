@@ -22,8 +22,22 @@ name = "fit"
 
 def add_arguments(parser) -> None:
     parser.add_argument("train_mrc_dir", help="training micrographs (.mrc)")
-    parser.add_argument("train_label_dir", help="training labels (.box)")
+    parser.add_argument(
+        "train_label_dir",
+        help="training labels: a BOX/STAR directory (--source labels),"
+        " a RELION particle .star (--source relion_star), "
+        "';'-separated patch pickles (--source extracted), or a "
+        "pre-picked results pickle (--source prepicked)",
+    )
     parser.add_argument("model_out", help="output checkpoint path")
+    parser.add_argument(
+        "--source",
+        choices=["labels", "relion_star", "extracted", "prepicked"],
+        default="labels",
+        help="training-data source, mirroring the reference "
+        "DataLoader's four train_type variants "
+        "(dataLoader.py:340-1045)",
+    )
     parser.add_argument(
         "--val_mrc_dir",
         default=None,
@@ -31,9 +45,25 @@ def add_arguments(parser) -> None:
     )
     parser.add_argument(
         "--val_label_dir",
-        required=True,
+        default=None,
         help="validation labels (.box) — the reference's explicit "
-        "validation directory (train.py:124-129)",
+        "validation directory (train.py:124-129); required for "
+        "--source labels, otherwise --val_ratio splits",
+    )
+    parser.add_argument(
+        "--val_ratio",
+        type=float,
+        default=0.1,
+        help="validation fraction for sources without a validation "
+        "directory (reference validation_ratio)",
+    )
+    parser.add_argument(
+        "--select",
+        type=float,
+        default=0.5,
+        help="--source prepicked selection: (0,1] score threshold, "
+        "(1,100] top percent, >100 top count "
+        "(reference train_number semantics)",
     )
     parser.add_argument("--particle_size", type=int, required=True)
     parser.add_argument("--batch_size", type=int, default=128)
@@ -65,24 +95,80 @@ def main(args) -> None:
         load_checkpoint,
         save_checkpoint,
     )
-    from repic_tpu.models.data import load_dataset
+    from repic_tpu.models import data as data_mod
     from repic_tpu.models.train import TrainConfig, fit
 
+    source = getattr(args, "source", "labels")
     try:
-        train_data, train_labels = load_dataset(
-            args.train_mrc_dir,
-            args.train_label_dir,
-            args.particle_size,
-            seed=args.seed,
-            patch_norm=args.patch_norm,
-        )
-        val_data, val_labels = load_dataset(
-            args.val_mrc_dir or args.train_mrc_dir,
-            args.val_label_dir,
-            args.particle_size,
-            seed=args.seed + 1,
-            patch_norm=args.patch_norm,
-        )
+        if source == "labels":
+            if not args.val_label_dir:
+                sys.exit(
+                    "error: --val_label_dir is required with "
+                    "--source labels"
+                )
+            train_data, train_labels = data_mod.load_dataset(
+                args.train_mrc_dir,
+                args.train_label_dir,
+                args.particle_size,
+                seed=args.seed,
+                patch_norm=args.patch_norm,
+            )
+            val_data, val_labels = data_mod.load_dataset(
+                args.val_mrc_dir or args.train_mrc_dir,
+                args.val_label_dir,
+                args.particle_size,
+                seed=args.seed + 1,
+                patch_norm=args.patch_norm,
+            )
+        else:
+            if args.val_label_dir or args.val_mrc_dir:
+                sys.exit(
+                    "error: --val_label_dir/--val_mrc_dir apply to "
+                    "--source labels only; the "
+                    f"{source!r} source validates on a --val_ratio "
+                    "split of the training data"
+                )
+            if source == "relion_star":
+                data, labels = data_mod.load_dataset_relion_star(
+                    args.train_label_dir,
+                    args.train_mrc_dir,
+                    args.particle_size,
+                    seed=args.seed,
+                    patch_norm=args.patch_norm,
+                )
+            elif source == "extracted":
+                data, labels = data_mod.load_dataset_extracted(
+                    args.train_mrc_dir,
+                    args.train_label_dir,
+                    patch_norm=args.patch_norm,
+                )
+            else:  # prepicked
+                data, labels = data_mod.load_dataset_prepicked(
+                    args.train_mrc_dir,
+                    args.train_label_dir,
+                    args.particle_size,
+                    select=args.select,
+                    seed=args.seed,
+                    patch_norm=args.patch_norm,
+                )
+            # validation split by ratio (reference validation_ratio
+            # semantics for the non-directory sources)
+            import numpy as np
+
+            rng = np.random.default_rng(args.seed)
+            data, labels = data_mod.shuffle_in_unison(
+                data, labels, rng
+            )
+            n_val = max(int(len(data) * args.val_ratio), 2)
+            if len(data) - n_val < 2:
+                sys.exit(
+                    f"error: dataset too small to split "
+                    f"({len(data)} patches, {n_val} requested for "
+                    "validation) — lower --val_ratio or provide more "
+                    "training data"
+                )
+            val_data, val_labels = data[:n_val], labels[:n_val]
+            train_data, train_labels = data[n_val:], labels[n_val:]
     except (FileNotFoundError, ValueError) as e:
         sys.exit(f"error: {e}")
 

@@ -229,6 +229,13 @@ def load_dataset(
         )
         all_pos.append(pos)
         all_neg.append(neg)
+    return _finish_patches(all_pos, all_neg, patch_norm)
+
+
+def _finish_patches(all_pos, all_neg, patch_norm):
+    """Shared tail of every training-data source: concatenate raw
+    patch lists, run the per-patch preparation chain on device, and
+    emit balanced (data, labels)."""
     pos = np.concatenate(all_pos) if all_pos else np.zeros((0, 2, 2))
     neg = np.concatenate(all_neg) if all_neg else np.zeros((0, 2, 2))
     if len(pos) == 0:
@@ -246,6 +253,215 @@ def load_dataset(
         [np.ones(len(pos), np.int32), np.zeros(len(neg), np.int32)]
     )
     return data, labels
+
+
+def load_dataset_relion_star(
+    star_path: str,
+    mrc_dir: str,
+    particle_size: int,
+    *,
+    seed: int = 1234,
+    patch_norm: str = "reference",
+):
+    """(data, labels) from a RELION particle STAR file.
+
+    The particle table carries ``_rlnMicrographName`` plus center
+    coordinates; micrographs are resolved by basename under
+    ``mrc_dir`` (the reference's train_type-2 source,
+    dataLoader.py:475-526 via load_Particle_From_starFile).
+    """
+    from repic_tpu.utils.coords import read_star
+
+    rng = np.random.default_rng(seed)
+    df = read_star(star_path)
+    cols = {c.lower(): c for c in df.columns if isinstance(c, str)}
+    mic_col = cols.get("_rlnmicrographname")
+    xcol = cols.get("_rlncoordinatex")
+    ycol = cols.get("_rlncoordinatey")
+    if mic_col is None or xcol is None or ycol is None:
+        raise ValueError(
+            f"{star_path}: need _rlnMicrographName and "
+            "_rlnCoordinateX/Y columns"
+        )
+    all_pos, all_neg = [], []
+    for mic_name, group in df.groupby(mic_col):
+        mrc_path = os.path.join(
+            mrc_dir, os.path.basename(str(mic_name))
+        )
+        if not os.path.isfile(mrc_path):
+            logger.warning("micrograph %s not found; skipped", mrc_path)
+            continue
+        raw = mrc.read_mrc(mrc_path).astype(np.float32)
+        if raw.ndim == 3:
+            raw = raw[0]
+        centers = np.stack(
+            [
+                group[xcol].astype(np.float64).to_numpy(),
+                group[ycol].astype(np.float64).to_numpy(),
+            ],
+            axis=1,
+        )
+        pos, neg = extract_micrograph_patches(
+            raw, centers, particle_size, rng
+        )
+        all_pos.append(pos)
+        all_neg.append(neg)
+    return _finish_patches(all_pos, all_neg, patch_norm)
+
+
+def extract_dataset(
+    mrc_dir: str,
+    label_dir: str,
+    particle_size: int,
+    out_pickle: str,
+    *,
+    seed: int = 1234,
+):
+    """Extract raw (positive, negative) patch lists to a pickle.
+
+    The cross-molecule training format (reference
+    dataLoader.py:732-876 extractData): the pickle holds
+    ``(positives, negatives)`` — two lists of 2-D raw binned patches
+    — consumable by :func:`load_dataset_extracted`, possibly mixed
+    with extractions from other molecules.
+    """
+    import pickle
+
+    rng = np.random.default_rng(seed)
+    boxes = _discover_labels(label_dir)
+    pairs = [
+        (m, boxes[os.path.splitext(os.path.basename(m))[0]])
+        for m in sorted(glob.glob(os.path.join(mrc_dir, "*.mrc")))
+        if os.path.splitext(os.path.basename(m))[0] in boxes
+    ]
+    if not pairs:
+        raise FileNotFoundError(
+            f"no micrograph/label pairs between {mrc_dir} and {label_dir}"
+        )
+    positives, negatives = [], []
+    for mrc_path, box_path in pairs:
+        raw = mrc.read_mrc(mrc_path).astype(np.float32)
+        if raw.ndim == 3:
+            raw = raw[0]
+        centers = _centers_from_label(box_path)
+        if len(centers) == 0:
+            continue
+        pos, neg = extract_micrograph_patches(
+            raw, centers, particle_size, rng
+        )
+        positives.extend(list(pos))
+        negatives.extend(list(neg))
+    with open(out_pickle, "wb") as f:
+        pickle.dump((positives, negatives), f)
+    return len(positives), len(negatives)
+
+
+def load_dataset_extracted(
+    base_dir: str,
+    input_files: str,
+    *,
+    patch_norm: str = "reference",
+    per_molecule_cap: int | None = None,
+):
+    """(data, labels) from pre-extracted patch pickles.
+
+    ``input_files`` is a ``;``-separated list of pickle names under
+    ``base_dir`` (the reference's cross-molecule train_type-3 source,
+    dataLoader.py:879-958): each holds ``(positives, negatives)`` raw
+    patch lists; ``per_molecule_cap`` bounds each molecule's
+    contribution the way the reference splits ``train_number`` evenly
+    across files.
+    """
+    import pickle
+
+    all_pos, all_neg = [], []
+    for name in input_files.split(";"):
+        path = os.path.join(base_dir, name.strip())
+        with open(path, "rb") as f:
+            positives, negatives = pickle.load(f)
+        n = len(positives)
+        if per_molecule_cap is not None:
+            n = min(n, per_molecule_cap)
+        if n == 0:
+            continue
+        # patch sizes differ across molecules; prepare_patches
+        # resizes to the common model input, so keep them as separate
+        # arrays per molecule.  Negatives may legitimately be short
+        # or empty (dense molecules exhaust rejection sampling).
+        all_pos.append(np.stack(positives[:n]))
+        neg = negatives[:n]
+        all_neg.append(
+            np.stack(neg)
+            if neg
+            else np.zeros((0,) + all_pos[-1].shape[1:], np.float32)
+        )
+    datas, labels = [], []
+    for pos, neg in zip(all_pos, all_neg):
+        d, l = _finish_patches([pos], [neg], patch_norm)
+        datas.append(d)
+        labels.append(l)
+    if not datas:
+        raise ValueError("no usable positive patches extracted")
+    return np.concatenate(datas), np.concatenate(labels)
+
+
+def load_dataset_prepicked(
+    mrc_dir: str,
+    results_pickle: str,
+    particle_size: int,
+    *,
+    select: float = 0.5,
+    seed: int = 1234,
+    patch_norm: str = "reference",
+):
+    """(data, labels) from pre-picked results (self-training).
+
+    ``results_pickle`` holds a list of per-micrograph lists of
+    ``[x, y, score, micrograph_name]`` rows (the reference's
+    train_type-4 source, dataLoader.py:960-1045).  ``select`` keeps
+    the reference's overloaded semantics: in ``(0, 1]`` it is a score
+    threshold; in ``(1, 100]`` the top-scoring percentage; above 100
+    the top-scoring count.
+    """
+    import pickle
+
+    rng = np.random.default_rng(seed)
+    with open(results_pickle, "rb") as f:
+        coordinate = pickle.load(f)
+    rows = [r for mic in coordinate for r in mic]
+    if not rows:
+        raise ValueError(f"{results_pickle}: no picked particles")
+    if select <= 1.0:
+        rows = [r for r in rows if float(r[2]) >= select]
+    else:
+        rows.sort(key=lambda r: float(r[2]), reverse=True)
+        keep = (
+            int(len(rows) * select / 100.0)
+            if select <= 100
+            else int(select)
+        )
+        rows = rows[:keep]
+    by_mic: dict[str, list] = {}
+    for r in rows:
+        by_mic.setdefault(os.path.basename(str(r[3])), []).append(r)
+    all_pos, all_neg = [], []
+    for mic_name, group in sorted(by_mic.items()):
+        mrc_path = os.path.join(mrc_dir, mic_name)
+        if not os.path.isfile(mrc_path):
+            logger.warning("micrograph %s not found; skipped", mrc_path)
+            continue
+        raw = mrc.read_mrc(mrc_path).astype(np.float32)
+        if raw.ndim == 3:
+            raw = raw[0]
+        centers = np.asarray(
+            [[float(r[0]), float(r[1])] for r in group], np.float64
+        )
+        pos, neg = extract_micrograph_patches(
+            raw, centers, particle_size, rng
+        )
+        all_pos.append(pos)
+        all_neg.append(neg)
+    return _finish_patches(all_pos, all_neg, patch_norm)
 
 
 def shuffle_in_unison(data, labels, rng: np.random.Generator):

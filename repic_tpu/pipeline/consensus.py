@@ -271,6 +271,19 @@ def _next_pow2(x: int) -> int:
     return bucket_size(int(x), minimum=2)
 
 
+@jax.jit
+def _probe_reduce(max_adjacency, num_cliques, max_cell_count):
+    """Reduce the three overflow probes to one (3,) device array so
+    the escalation check costs a single host transfer."""
+    return jnp.stack(
+        [
+            jnp.max(max_adjacency),
+            jnp.max(num_cliques),
+            jnp.max(max_cell_count),
+        ]
+    ).astype(jnp.int32)
+
+
 def run_consensus_batch(
     batch: PaddedBatch,
     box_size,
@@ -363,11 +376,19 @@ def run_consensus_batch(
         res = fn(xy, conf, mask, box_arg)
         # Escalate straight to the observed requirement (each distinct
         # capacity config is a fresh XLA compile — don't ladder by 2x).
-        max_adj = int(jnp.max(res.max_adjacency))
-        n_cliques = int(jnp.max(res.num_cliques))
+        # The three probes are reduced on device and fetched in ONE
+        # transfer: per-scalar fetches each pay a full host<->device
+        # round trip (expensive over a tunneled TPU).
+        max_adj, n_cliques, max_cell = (
+            int(v) for v in np.asarray(
+                _probe_reduce(
+                    res.max_adjacency, res.num_cliques,
+                    res.max_cell_count,
+                )
+            )
+        )
         retry = False
         if grid is not None:
-            max_cell = int(jnp.max(res.max_cell_count))
             if max_cell > cell_cap:
                 cell_cap = _next_pow2(max_cell)
                 retry = True
@@ -398,10 +419,11 @@ def write_consensus_boxes(
     top-N cutoff.
     """
     os.makedirs(out_dir, exist_ok=True)
-    picked = np.asarray(res.picked)
-    rep_xy = np.asarray(res.rep_xy)
-    confidence = np.asarray(res.confidence)
-    rep_slot = np.asarray(res.rep_slot)
+    # one batched fetch for all four output arrays (per-array fetches
+    # each pay a device round trip — expensive over a tunneled TPU)
+    picked, rep_xy, confidence, rep_slot = jax.device_get(
+        (res.picked, res.rep_xy, res.confidence, res.rep_slot)
+    )
     sizes = np.asarray(box_size)
     counts = {}
     for i, name in enumerate(batch.names):

@@ -295,3 +295,136 @@ def test_label_discovery_deterministic_collision(tmp_path):
     labels = data_mod._discover_labels(str(tmp_path))
     assert labels["mic1"].endswith("mic1.box")
     assert labels["mic2"].endswith("mic2.star")
+
+
+def _one_micrograph_pair(tmp_path, seed=21):
+    rng = np.random.default_rng(seed)
+    img, centers = make_micrograph(rng)
+    centers = np.round(centers)
+    (tmp_path / "mrc").mkdir(exist_ok=True)
+    (tmp_path / "lbl").mkdir(exist_ok=True)
+    mrc.write_mrc(str(tmp_path / "mrc" / "m0.mrc"), img)
+    write_box(
+        str(tmp_path / "lbl" / "m0.box"),
+        centers - PARTICLE / 2,
+        np.ones(len(centers)),
+        PARTICLE,
+    )
+    return img, centers
+
+
+def test_relion_star_source_matches_box(tmp_path):
+    """Particle-STAR source (reference train_type 2): same dataset as
+    the per-micrograph BOX source for identical coordinates."""
+    _, centers = _one_micrograph_pair(tmp_path)
+    star = tmp_path / "particles.star"
+    with open(star, "wt") as f:
+        f.write("data_\n\nloop_\n")
+        f.write(
+            "_rlnMicrographName #1\n"
+            "_rlnCoordinateX #2\n_rlnCoordinateY #3\n"
+        )
+        for cx, cy in centers:
+            f.write(f"path/to/m0.mrc\t{cx:.1f}\t{cy:.1f}\n")
+    d_star, l_star = data_mod.load_dataset_relion_star(
+        str(star), str(tmp_path / "mrc"), PARTICLE
+    )
+    d_box, l_box = data_mod.load_dataset(
+        str(tmp_path / "mrc"), str(tmp_path / "lbl"), PARTICLE
+    )
+    np.testing.assert_array_equal(l_star, l_box)
+    np.testing.assert_allclose(d_star, d_box, atol=1e-6)
+
+
+def test_extracted_source_roundtrip(tmp_path):
+    """extract_dataset -> load_dataset_extracted (reference train_type
+    3 cross-molecule format), incl. multi-file and per-molecule cap."""
+    _one_micrograph_pair(tmp_path)
+    n_pos, n_neg = data_mod.extract_dataset(
+        str(tmp_path / "mrc"), str(tmp_path / "lbl"), PARTICLE,
+        str(tmp_path / "molA.pickle"),
+    )
+    assert n_pos > 0 and n_neg == n_pos
+    import shutil
+
+    shutil.copy(tmp_path / "molA.pickle", tmp_path / "molB.pickle")
+    data, labels = data_mod.load_dataset_extracted(
+        str(tmp_path), "molA.pickle;molB.pickle"
+    )
+    assert len(data) == 2 * (n_pos + n_neg)
+    assert labels.sum() * 2 == len(labels)
+    capped, cl = data_mod.load_dataset_extracted(
+        str(tmp_path), "molA.pickle;molB.pickle", per_molecule_cap=3
+    )
+    assert len(capped) == 2 * 6
+
+    d1, l1 = data_mod.load_dataset_extracted(
+        str(tmp_path), "molA.pickle"
+    )
+    ref, _ = data_mod.load_dataset(
+        str(tmp_path / "mrc"), str(tmp_path / "lbl"), PARTICLE
+    )
+    np.testing.assert_allclose(d1, ref, atol=1e-6)
+
+
+def test_prepicked_source_selection_modes(tmp_path):
+    """Pre-picked results source (reference train_type 4): threshold,
+    top-percent, and top-count selection semantics."""
+    import pickle
+
+    _, centers = _one_micrograph_pair(tmp_path)
+    scores = np.linspace(0.1, 0.9, len(centers))
+    rows = [
+        [float(x), float(y), float(s), "m0.mrc"]
+        for (x, y), s in zip(centers, scores)
+    ]
+    results = tmp_path / "autopick_results.pickle"
+    with open(results, "wb") as f:
+        pickle.dump([rows], f)
+
+    # threshold mode: keep scores >= 0.5
+    d, l = data_mod.load_dataset_prepicked(
+        str(tmp_path / "mrc"), str(results), PARTICLE, select=0.5
+    )
+    want = int((scores >= 0.5).sum())
+    assert l.sum() == want
+
+    # top-percent mode
+    d, l = data_mod.load_dataset_prepicked(
+        str(tmp_path / "mrc"), str(results), PARTICLE, select=50.0
+    )
+    assert l.sum() == len(centers) // 2
+
+    # top-count mode
+    d, l = data_mod.load_dataset_prepicked(
+        str(tmp_path / "mrc"), str(results), PARTICLE, select=101.0
+    )
+    assert l.sum() == min(101, len(centers))
+
+
+def test_fit_cli_extracted_source(tmp_path):
+    from repic_tpu.main import main as cli_main
+
+    _one_micrograph_pair(tmp_path)
+    data_mod.extract_dataset(
+        str(tmp_path / "mrc"), str(tmp_path / "lbl"), PARTICLE,
+        str(tmp_path / "mol.pickle"),
+    )
+    model_path = str(tmp_path / "m.rptpu")
+    cli_main(
+        [
+            "fit",
+            str(tmp_path),
+            "mol.pickle",
+            model_path,
+            "--source", "extracted",
+            "--particle_size", str(PARTICLE),
+            "--batch_size", "8",
+            "--max_epochs", "2",
+            "--val_ratio", "0.25",
+        ]
+    )
+    from repic_tpu.models.checkpoint import load_checkpoint
+
+    params, meta = load_checkpoint(model_path)
+    assert meta["particle_size"] == PARTICLE
