@@ -189,20 +189,22 @@ def main(args):
                     _vertex_tuples(node_id[c], node_xy[c])
                 )
             if not args.get_cc:
-                in_cliques = [set() for _ in range(k)]
-                for c in range(n):
-                    for p in range(k):
-                        in_cliques[p].add(int(member_idx[c, p]))
                 for p in range(k):
-                    for j in range(counts[p]):
-                        if j not in in_cliques[p]:
-                            entry = [None] * k
-                            entry[p] = (
-                                float(sets[p].xy[j, 0]),
-                                float(sets[p].xy[j, 1]),
-                                int(id_base[p] + j),
-                            )
-                            coords_out.append(entry)
+                    present = (
+                        np.unique(member_idx[:, p])
+                        if n
+                        else np.empty(0, np.int64)
+                    )
+                    for j in np.setdiff1d(
+                        np.arange(counts[p]), present
+                    ):
+                        entry = [None] * k
+                        entry[p] = (
+                            float(sets[p].xy[j, 0]),
+                            float(sets[p].xy[j, 1]),
+                            int(id_base[p] + j),
+                        )
+                        coords_out.append(entry)
         else:
             rep_particle = member_idx[np.arange(n), rep_slot]
             rep_ids = np.asarray(id_base)[rep_slot] + rep_particle
@@ -210,28 +212,26 @@ def main(args):
 
         # Constraint matrix over sorted participating vertices
         # (reference sorts (x, y, id) tuples — get_cliques.py:164).
-        all_nodes = sorted(
-            {
-                (float(node_xy[c, p, 0]), float(node_xy[c, p, 1]), int(node_id[c, p]))
-                for c in range(n)
-                for p in range(k)
-            }
+        # Vectorized: np.unique(axis=0) sorts rows lexicographically,
+        # which equals sorted() on the (x, y, id) tuples; the inverse
+        # map IS the row index of each (clique, picker) entry.  The
+        # per-clique Python loop this replaces dominated host time at
+        # stress scale (50k cliques x K entries per micrograph).
+        entries = np.concatenate(
+            [
+                node_xy.reshape(n * k, 2).astype(np.float64),
+                node_id.reshape(n * k, 1).astype(np.float64),
+            ],
+            axis=1,
         )
-        index = {node: r for r, node in enumerate(all_nodes)}
-        rows, cols = [], []
-        for c in range(n):
-            for p in range(k):
-                node = (
-                    float(node_xy[c, p, 0]),
-                    float(node_xy[c, p, 1]),
-                    int(node_id[c, p]),
-                )
-                rows.append(index[node])
-                cols.append(c)
+        uniq, inverse = np.unique(entries, axis=0, return_inverse=True)
+        n_vertices = len(uniq)
+        cols = np.repeat(np.arange(n, dtype=np.int64), k)
         a_mat = coo_matrix(
-            ([1] * len(cols), (rows, cols)), shape=(len(all_nodes), n)
+            (np.ones(n * k, np.int64), (inverse.reshape(-1), cols)),
+            shape=(n_vertices, n),
         )
-        print(f"--- {mname}: {n} cliques, {len(all_nodes)} vertices")
+        print(f"--- {mname}: {n} cliques, {n_vertices} vertices")
 
         for label, val in zip(
             [

@@ -1,0 +1,85 @@
+"""Generate supp_data/parameters.tsv from the framework's defaults.
+
+The reference documents its algorithm parameters in a spreadsheet
+(supplemental_data_file_2.ods); here the equivalent record is derived
+from the code itself — every row cites the constant it reports, so
+the table cannot drift from the implementation.
+"""
+
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+
+def rows():
+    from repic_tpu.models import cnn, data, train
+    from repic_tpu.ops import cliques
+    from repic_tpu.pipeline import consensus
+
+    tc = train.TrainConfig()
+    yield from [
+        ("consensus", "iou_threshold", cliques.DEFAULT_THRESHOLD,
+         "ops/cliques.py DEFAULT_THRESHOLD (reference get_cliques.py:138)"),
+        ("consensus", "clique_weight",
+         "median(member conf) * median(edge IoU)",
+         "ops/cliques.py _assemble_block (reference get_cliques.py:186-190)"),
+        ("consensus", "representative", "max intra-clique weighted degree",
+         "ops/cliques.py _assemble_block (reference get_cliques.py:182-183)"),
+        ("consensus", "spatial_threshold_particles",
+         consensus.SPATIAL_THRESHOLD,
+         "pipeline/consensus.py SPATIAL_THRESHOLD"),
+        ("cnn_picker", "patch_size", cnn.PATCH_SIZE,
+         "models/cnn.py PATCH_SIZE (reference autoPick.py:48)"),
+        ("cnn_picker", "conv_spec", cnn.CONV_SPEC,
+         "models/cnn.py CONV_SPEC (reference deepModel.py:143-162)"),
+        ("cnn_picker", "fc_weight_decay", cnn.FC_WEIGHT_DECAY,
+         "models/cnn.py (reference deepModel.py:164-173)"),
+        ("cnn_picker", "negative_distance_ratio",
+         data.NEGATIVE_DISTANCE_RATIO,
+         "models/data.py (reference dataLoader.py:340)"),
+        ("training", "batch_size", tc.batch_size,
+         "models/train.py TrainConfig"),
+        ("training", "learning_rate", tc.learning_rate,
+         "models/train.py (reference train.py REPIC patch)"),
+        ("training", "lr_decay_factor", tc.lr_decay_factor,
+         "models/train.py (staircase x0.95 / 8 epochs, train.py:167)"),
+        ("training", "momentum", tc.momentum, "models/train.py"),
+        ("training", "early_stop_patience", tc.patience,
+         "models/train.py (reference train.py:186)"),
+        ("training", "max_epochs", tc.max_epochs, "models/train.py"),
+        ("training", "seed", tc.seed,
+         "models/train.py (reference train.py:73-75)"),
+        ("cryolo_adapter", "lowpass_cutoff", 0.1,
+         "pipeline/pickers.py _write_config (reference run_cryolo.sh:22-27)"),
+        ("cryolo_adapter", "predict_threshold", 0.0,
+         "pipeline/pickers.py predict_cmd (reference run_cryolo.sh:34)"),
+        ("cryolo_adapter", "train_batch_size", 2,
+         "pipeline/pickers.py _write_config (reference fit_cryolo.sh:38)"),
+        ("cryolo_adapter", "warm_restart/early_stop/seed", "5/32/1",
+         "pipeline/pickers.py fit_cmd (reference fit_cryolo.sh:40-44)"),
+        ("deep_adapter", "predict_threshold", 0.0,
+         "pipeline/pickers.py predict_cmd (reference run_deep.sh:28)"),
+        ("deep_adapter", "train_type", 1,
+         "pipeline/pickers.py fit_cmd (reference fit_deep.sh:44)"),
+        ("topaz_adapter", "expected_particles_factor", 1.25,
+         "pipeline/pickers.py fit_cmd (reference fit_topaz.sh:33)"),
+        ("subsets", "split_seed", 0,
+         "utils/subsets.py (reference build_subsets.py:16)"),
+        ("subsets", "val_micrographs", 6,
+         "utils/subsets.py (reference build_subsets.py)"),
+    ]
+
+
+def main():
+    out = os.path.join(HERE, "parameters.tsv")
+    with open(out, "wt") as f:
+        f.write("component\tparameter\tvalue\tsource\n")
+        for comp, param, value, source in rows():
+            f.write(f"{comp}\t{param}\t{value}\t{source}\n")
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
