@@ -222,6 +222,30 @@ def _make_cell_probe(grid: int):
     return jax.jit(jax.vmap(probe_one, in_axes=(0, 0, None)))
 
 
+@lru_cache(maxsize=8)
+def _make_dense_probe(threshold: float):
+    """Jitted adjacency probe for the dense path: max above-threshold
+    neighbor count over all anchor pairs, so the D^(K-1) clique
+    assembly compiles at the measured D instead of the default 16
+    (the IoU matrices here cost a small fraction of the assembly)."""
+    from repic_tpu.ops.iou import pairwise_iou_matrix
+
+    def probe_one(xy, mask, box_size):
+        K = xy.shape[0]
+        sizes = jnp.broadcast_to(
+            jnp.asarray(box_size, xy.dtype).reshape(-1), (K,)
+        )
+        adjs = []
+        for p in range(1, K):
+            iou = pairwise_iou_matrix(
+                xy[0], mask[0], xy[p], mask[p], sizes[0], sizes[p]
+            )
+            adjs.append(jnp.max(jnp.sum(iou > threshold, axis=1)))
+        return jnp.max(jnp.stack(adjs))
+
+    return jax.jit(jax.vmap(probe_one, in_axes=(0, 0, None)))
+
+
 @lru_cache(maxsize=32)
 def _make_spatial_probe(grid: int, cell_capacity: int, threshold: float):
     """Jitted adjacency probe via the bucketed neighbor search (d=1).
@@ -353,12 +377,21 @@ def run_consensus_batch(
             probe = _make_spatial_probe(grid, cell_cap, threshold)
             adj = probe(batch.xy, batch.mask, box_arg)
             # The probes give exact requirements; max_neighbors is
-            # only the dense-path default — override both directions.
+            # only a default — override in both directions.
             d = _next_pow2(max(int(jnp.max(adj)), 2))
+    elif known is None:
+        adj = _make_dense_probe(threshold)(
+            batch.xy, batch.mask, box_arg
+        )
+        d = _next_pow2(max(int(jnp.max(adj)), 2))
     if known:
-        d = max(d, known[0]) if not spatial else known[0]
-        cap = max(cap, known[1])
-        cell_cap = max(cell_cap, known[2])
+        # Trust the recorded adequate config COMPLETELY.  Mixing it
+        # with the caller defaults (e.g. max(d, known_d)) re-anchors
+        # to max_neighbors=16 and silently swaps in a program with
+        # 16x the candidate work — plus one extra compile — on every
+        # repeat batch; the escalation loop below still catches any
+        # data drift upward.
+        d, cap, cell_cap = known
     while True:
         fn = make_batched_consensus(
             threshold=threshold,

@@ -83,3 +83,34 @@ def test_stress_feasibility_and_counts(stress_batch):
     mem = np.asarray(res.member_idx[0])[picked]
     used = [(p, int(row[p])) for row in mem for p in range(K)]
     assert len(used) == len(set(used))  # no particle reused
+
+
+def test_repeat_batches_reuse_probed_config():
+    """Repeat same-shape batches must reuse the first call's probed
+    capacity config (one jit entry), not re-anchor to the default
+    max_neighbors and compile a second, larger program."""
+    import repic_tpu.pipeline.consensus as C
+
+    rng = np.random.default_rng(44)
+    base = rng.uniform(0, 3000, size=(120, 2)).astype(np.float32)
+    xy = np.stack(
+        [base + rng.normal(0, 15, base.shape).astype(np.float32)
+         for _ in range(3)]
+    )[None]
+    conf = rng.uniform(0.1, 1, size=(1, 3, 120)).astype(np.float32)
+    mask = np.ones((1, 3, 120), bool)
+    batch = PaddedBatch(
+        xy=xy, conf=conf, mask=mask, names=("m0",),
+        counts=np.full((1, 3), 120, np.int32),
+    )
+    key = (xy.shape, (180.0,), 0.3, False)
+    C._LAST_GOOD_CONFIG.pop(key, None)
+    C.run_consensus_batch(batch, 180.0, use_mesh=False)
+    first = C._LAST_GOOD_CONFIG[key]
+    size_after_first = C._make_batched_consensus.cache_info().currsize
+    C.run_consensus_batch(batch, 180.0, use_mesh=False)
+    assert C._LAST_GOOD_CONFIG[key] == first  # config stable
+    assert (
+        C._make_batched_consensus.cache_info().currsize
+        == size_after_first
+    )  # no second program compiled for the same shape
