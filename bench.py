@@ -42,7 +42,8 @@ EXAMPLES = os.environ.get(
 
 METRIC = "EMPIAR-10017 3-picker consensus (clique+ILP), end-to-end"
 
-CHILD_TIMEOUT_S = int(os.environ.get("REPIC_BENCH_TIMEOUT", "600"))
+CHILD_TIMEOUT_S = int(os.environ.get("REPIC_BENCH_TIMEOUT", "420"))
+PROBE_TIMEOUT_S = int(os.environ.get("REPIC_BENCH_PROBE_TIMEOUT", "75"))
 
 
 def _synthesize(dst, n_micro=12, n_per=700, k=3, seed=0):
@@ -153,15 +154,56 @@ def _run_child(force_cpu: bool, timeout_s: int):
     return False, None, f"rc={proc.returncode}: {tail}"
 
 
+def _probe_default_platform() -> bool:
+    """Cheap subprocess probe: can the default backend initialize?
+
+    A wedged TPU tunnel can hang ``import jax``/device init
+    *indefinitely* — probing with a short timeout bounds the
+    worst-case time to CPU fallback (a full measurement child would
+    burn its whole timeout first).
+    """
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; print(jax.devices()[0].platform)",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        print(
+            f"backend probe hung (> {PROBE_TIMEOUT_S}s)",
+            file=sys.stderr,
+            flush=True,
+        )
+        return False
+    ok = proc.returncode == 0 and bool(proc.stdout.strip())
+    if not ok:
+        print(
+            f"backend probe failed: {proc.stderr[-400:]}",
+            file=sys.stderr,
+            flush=True,
+        )
+    return ok
+
+
 def main():
     if "--child" in sys.argv:
         return run_measurement(force_cpu="--cpu" in sys.argv)
 
     # 3 attempts on the default (TPU-preferring) platform with
     # backoff — transient "TPU backend setup/compile error
-    # (Unavailable)" is exactly what round 1 died on.
+    # (Unavailable)" is exactly what round 1 died on.  Each attempt
+    # starts with a short-timeout device probe so a hung TPU tunnel
+    # costs ~75 s, not a full measurement timeout.
     last_err = ""
     for attempt in range(3):
+        if not _probe_default_platform():
+            last_err = "backend probe failed or hung"
+            break  # a dead/hung backend won't heal with backoff
         ok, line, err = _run_child(
             force_cpu=False, timeout_s=CHILD_TIMEOUT_S
         )
