@@ -11,8 +11,12 @@ array one slice at a time (score_detections.py:30-37).  Here the union
 mask is built with a 2-D *difference array*: each box scatters +1/-1
 at its four corners and two cumulative sums recover the coverage
 count — O(n) scatter + O(H*W) cumsum, one fused XLA program with
-static shapes, no per-box Python loop.  Boxes are pre-rounded and
-clipped host-side so padded slots rasterize as zero-area.
+static shapes, no per-box Python loop.  Boxes are pre-rounded
+host-side; negative-corner boxes are dropped to match the
+reference's numpy-slice behavior (see _to_int_boxes), and the
+remaining edges clip to the micrograph.  Gated to 1e-6 against the
+executed reference on examples/10017
+(tests/golden/ref_scores_cryolo_vs_topaz_10017.tsv).
 
 Known deviation: an empty ground-truth set yields recall 0.0 here
 (the reference divides by zero and propagates NaN).
@@ -90,13 +94,21 @@ def segmentation_scores_masked(
 def _to_int_boxes(df, conf_thresh=None):
     """Host-side prep: threshold on confidence, round to int boxes
     (reference rounds with builtin round — banker's rounding — which
-    np.rint reproduces; score_detections.py:31,36)."""
+    np.rint reproduces; score_detections.py:31,36).
+
+    Boxes with a negative rounded corner are dropped: the reference
+    paints with ``arr[y:y+h, x:x+w]`` and a negative numpy slice
+    start wraps to ``dim+start``, producing an EMPTY slice whenever
+    the micrograph is larger than the box (always in practice) — so
+    edge picks with negative corners contribute no pixels there
+    (score_detections.py:30-37), and must not here either."""
     if len(df) == 0:
         return np.zeros((0, 4), np.int32)
     arr = df[["x", "y", "w", "h"]].to_numpy(float)
     if conf_thresh is not None and "conf" in df.columns:
         arr = arr[df["conf"].to_numpy(float) >= conf_thresh]
-    return np.rint(arr).astype(np.int32)
+    out = np.rint(arr).astype(np.int32)
+    return out[(out[:, 0] >= 0) & (out[:, 1] >= 0)]
 
 
 def get_segmentation_scores(

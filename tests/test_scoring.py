@@ -151,3 +151,45 @@ def test_cli_end_to_end(tmp_path):
     vals = lines[1].split("\t")
     assert vals[0] == "m1"
     assert float(vals[1]) == 1.0
+
+
+def test_golden_scores_match_executed_reference():
+    """Gate the scorer against the reference implementation's actual
+    output: tests/golden/ref_scores_cryolo_vs_topaz_10017.tsv was
+    produced by EXECUTING reference score_detections.py (crYOLO picks
+    as ground truth, topaz picks as detections) on examples/10017."""
+    import os
+
+    from tests.conftest import REFERENCE_EXAMPLES, reference_available
+
+    if not reference_available():
+        import pytest
+
+        pytest.skip("reference example data not mounted")
+    import glob
+
+    from repic_tpu.utils.scoring import score_box_files
+
+    golden_path = os.path.join(
+        os.path.dirname(__file__),
+        "golden",
+        "ref_scores_cryolo_vs_topaz_10017.tsv",
+    )
+    golden = {}
+    with open(golden_path) as f:
+        next(f)
+        for line in f:
+            name, *vals = line.split("\t")
+            golden[name] = [float(v) for v in vals]
+
+    rows = score_box_files(
+        sorted(glob.glob(os.path.join(REFERENCE_EXAMPLES, "crYOLO", "*.box"))),
+        sorted(glob.glob(os.path.join(REFERENCE_EXAMPLES, "topaz", "*.box"))),
+    )
+    assert len(rows) == len(golden) == 12
+    for stem, precision, recall, f1, pos_frac in rows:
+        want = golden[stem]
+        np.testing.assert_allclose(
+            [precision, recall, f1, pos_frac], want, rtol=1e-6,
+            err_msg=stem,
+        )
