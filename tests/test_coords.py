@@ -227,3 +227,39 @@ def test_cli_registered():
         ["convert", "in.box", "outdir", "-f", "box", "-t", "star"]
     )
     assert args.in_fmt == "box"
+
+
+def test_golden_convert_matches_executed_reference(tmp_path):
+    """Byte-level gate against the EXECUTED reference converter:
+    tests/golden/convert/* were produced by running the reference's
+    process_conversion on a topaz BOX file of examples/10017
+    (box->star, box->tsv, star->box with boxsize 180)."""
+    import os
+
+    golden_dir = os.path.join(
+        os.path.dirname(__file__), "golden", "convert"
+    )
+    src = (
+        "/root/reference/examples/10017/topaz/"
+        "Falcon_2012_06_12-14_33_35_0.box"
+    )
+    if not os.path.isfile(src):
+        pytest.skip("reference example data not mounted")
+    stem = "Falcon_2012_06_12-14_33_35_0"
+
+    from repic_tpu.utils.coords import convert
+
+    for in_fmt, out_fmt, ext, source in (
+        ("box", "star", ".star", src),
+        ("box", "tsv", ".tsv", src),
+        ("star", "box", ".box",
+         os.path.join(golden_dir, f"{stem}.star")),
+    ):
+        out = tmp_path / f"{in_fmt}_to_{out_fmt}"
+        convert(
+            [source], in_fmt, out_fmt,
+            boxsize=180, out_dir=str(out), quiet=True, force=True,
+        )
+        got = (out / f"{stem}{ext}").read_text()
+        want = open(os.path.join(golden_dir, f"{stem}{ext}")).read()
+        assert got == want, f"{in_fmt}->{out_fmt} differs"
