@@ -13,6 +13,20 @@ histogram plot.
 Unlike the reference there is no module-level RNG
 (build_subsets.py:16) — the generator is seeded per call, so repeated
 invocations in one process are identically reproducible.
+
+Two reference deviations, both deliberate (pinned by
+tests/test_subsets_golden.py against the executed reference):
+
+* the reference's defocus-file branch is dead code — its main() makes
+  ``use_defocus_values`` function-local by assigning it in the
+  file-missing branch, so an EXISTING defocus file raises
+  UnboundLocalError (build_subsets.py:117-121,135).  Here the branch
+  works as documented;
+* the reference enumerates micrographs with unsorted ``glob.glob``,
+  making split membership filesystem-hash-order dependent; here
+  enumeration is sorted, so splits are machine-independent.  Given
+  identical enumeration order the sampled membership is identical
+  (same rng stream, verified by the golden test).
 """
 
 import os
