@@ -15,12 +15,24 @@ of get_cliques.py:215-222) and either phase can interoperate with the
 reference's counterpart.  The compute, however, is one batched jitted
 program over all micrographs instead of a per-micrograph Python loop.
 
-Known divergence (documented, intentional): with ``--multi_out`` the
-reference compares 4-tuple raw coordinates against 3-tuple graph nodes
-when appending "unmatched" singletons (get_cliques.py:210-213), so its
-difference-set is always the *entire* particle list.  Here singletons
-are the particles genuinely absent from every clique — the documented
-intent ("vertices not found in chosen cliques", run_ilp.py:93-94).
+Known divergences (documented, intentional; both pinned against the
+EXECUTED reference by tests/test_multiout_golden.py):
+
+* with ``--multi_out`` the reference compares 4-tuple raw coordinates
+  against 3-tuple graph nodes when appending "unmatched" singletons
+  (get_cliques.py:210-213), so its difference-set is always the
+  *entire* particle list.  Here singletons are the particles genuinely
+  absent from every clique — the documented intent ("vertices not
+  found in chosen cliques", run_ilp.py:93-94).  The final run_ilp
+  multi-out TSV is identical either way (its re-add pass recomputes
+  membership from all rows).
+* the reference's ``--multi_out`` picker-column assignment is
+  corrupted: ``add_nodes_to_graph`` receives the full picker list for
+  every pair (get_cliques.py:143), so node name attributes are
+  overwritten with wrong labels (e.g. every topaz node ends up named
+  'deepPicker') and the sort-by-name column layout scatters
+  coordinates into the wrong pickers' columns.  Here each column's
+  coordinate really comes from that picker's BOX file.
 """
 
 import os
