@@ -1,0 +1,88 @@
+"""Architecture parity: our Flax CNN vs the EXECUTED reference TF model.
+
+TensorFlow is available in this image, so the vendored reference
+``deepModel.DeepModel`` evaluation graph (deepModel.py:204-241, the
+exact graph ``autoPick.py`` restores checkpoints into) can be built
+for real.  Our trained Flax parameters are assigned into its TF
+variables and the softmax predictions of both stacks are compared on
+random patches — pinning conv/pool/flatten/FC semantics end to end
+(VALID paddings, pool strides, (h, w, c) flatten order, bias layouts).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+PATCHES = "/root/reference/docs/patches/deeppicker"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(PATCHES), reason="reference patches not mounted"
+)
+
+
+@pytest.fixture(scope="module")
+def tf_and_model():
+    tf_mod = pytest.importorskip("tensorflow.compat.v1")
+    sys.path.insert(0, PATCHES)
+    try:
+        import deepModel as ref_deep_model
+    finally:
+        sys.path.remove(PATCHES)
+    return tf_mod, ref_deep_model
+
+
+def test_flax_cnn_matches_reference_tf_graph(tf_and_model):
+    tf, ref_deep_model = tf_and_model
+    import jax
+    import jax.numpy as jnp
+
+    from repic_tpu.models.cnn import PATCH_SIZE, PickerCNN
+
+    batch = 16
+    rng = np.random.default_rng(7)
+    data = rng.normal(
+        0, 1, size=(batch, PATCH_SIZE, PATCH_SIZE, 1)
+    ).astype(np.float32)
+
+    # our model + params
+    model = PickerCNN()
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, PATCH_SIZE, PATCH_SIZE, 1))
+    )["params"]
+    ours_logits = np.asarray(model.apply({"params": params}, data))
+    ours_softmax = np.asarray(jax.nn.softmax(ours_logits, axis=1))
+
+    # reference TF evaluation graph with OUR weights assigned
+    tf.disable_eager_execution()
+    graph = tf.Graph()
+    with graph.as_default():
+        ref = ref_deep_model.DeepModel(
+            180, [batch, PATCH_SIZE, PATCH_SIZE, 1], 2
+        )
+        ref.init_model_graph_evaluate()
+        assign = {
+            ref.kernel1: params["backbone"]["conv1"]["kernel"],
+            ref.biases1: params["backbone"]["conv1"]["bias"],
+            ref.kernel2: params["backbone"]["conv2"]["kernel"],
+            ref.biases2: params["backbone"]["conv2"]["bias"],
+            ref.kernel3: params["backbone"]["conv3"]["kernel"],
+            ref.biases3: params["backbone"]["conv3"]["bias"],
+            ref.kernel4: params["backbone"]["conv4"]["kernel"],
+            ref.biases4: params["backbone"]["conv4"]["bias"],
+            ref.weights_fc1: params["fc1"]["kernel"],
+            ref.biases_fc1: params["fc1"]["bias"],
+            ref.weights_fc2: params["fc2"]["kernel"],
+            ref.biases_fc2: params["fc2"]["bias"],
+        }
+        with tf.Session(graph=graph) as sess:
+            for var, val in assign.items():
+                sess.run(var.assign(np.asarray(val)))
+            want = ref.evaluation(data, sess)
+
+    np.testing.assert_allclose(ours_softmax, want, atol=1e-5)
+    # and the hard class decisions agree everywhere
+    np.testing.assert_array_equal(
+        np.argmax(ours_softmax, 1), np.argmax(want, 1)
+    )
