@@ -84,9 +84,16 @@ def bytescale(patches: jnp.ndarray) -> jnp.ndarray:
 
 
 def standardize_patches(patches: jnp.ndarray) -> jnp.ndarray:
-    """Per-patch z-score (autoPicker.py:188-190)."""
+    """Per-patch z-score (autoPicker.py:188-190).
+
+    Uses the UNBIASED std (ddof=1) because the reference divides by
+    ``torch.std``, whose default correction is 1."""
+    n = patches.shape[-2] * patches.shape[-1]
     mean = patches.mean(axis=(-2, -1), keepdims=True)
-    std = patches.std(axis=(-2, -1), keepdims=True)
+    var = jnp.square(patches - mean).sum(
+        axis=(-2, -1), keepdims=True
+    ) / jnp.maximum(n - 1, 1)
+    std = jnp.sqrt(var)
     return (patches - mean) / jnp.where(std > 0, std, 1.0)
 
 
@@ -102,7 +109,16 @@ def resize_patches(patches: jnp.ndarray, out_size: int) -> jnp.ndarray:
 
 
 def prepare_patches(patches: jnp.ndarray, out_size: int) -> jnp.ndarray:
-    """bytescale -> resize -> standardize, the full per-patch chain."""
+    """bytescale -> resize (round back to uint8 values) -> standardize,
+    the full per-patch chain.
+
+    The round+clamp between resize and standardize matches torchvision
+    ``F.resize`` on a uint8 tensor (the reference path,
+    dataLoader.py:157-160): interpolation runs in float but the result
+    is rounded half-to-even and clamped back to [0, 255] before the
+    z-score — omitting it shifts standardized values by up to ~0.02.
+    """
+    resized = resize_patches(bytescale(patches), out_size)
     return standardize_patches(
-        resize_patches(bytescale(patches), out_size)
+        jnp.clip(jnp.round(resized), 0.0, 255.0)
     )

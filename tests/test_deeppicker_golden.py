@@ -107,3 +107,58 @@ def test_peak_detection_matches_reference(ref_fns, rng, window):
         (int(x), int(y), round(float(s), 6)) for x, y, s in got
     }
     assert got_set == want_set
+
+
+def test_patch_resize_matches_torch_antialias(rng):
+    """The reference resizes patches with torchvision F.resize
+    (bilinear, antialias=True; dataLoader.py preprocess_particle
+    REPIC_PATCH).  torchvision is absent here, but its antialiased
+    bilinear kernel is torch.nn.functional.interpolate's — execute
+    that as the oracle for our jax.image.resize path."""
+    torch = pytest.importorskip("torch")
+
+    from repic_tpu.models import preprocess as pp
+
+    patches = rng.normal(0, 3, size=(5, 40, 40)).astype(np.float32)
+    got = np.asarray(pp.resize_patches(patches, 64))
+    want = (
+        torch.nn.functional.interpolate(
+            torch.from_numpy(patches).unsqueeze(1),
+            size=(64, 64),
+            mode="bilinear",
+            antialias=True,
+        )
+        .squeeze(1)
+        .numpy()
+    )
+    np.testing.assert_allclose(got, want, atol=2e-3, rtol=1e-3)
+
+
+def test_full_patch_chain_matches_torch_oracle(rng):
+    """bytescale -> antialiased resize (round-tripped through uint8,
+    exactly as torchvision F.resize does on a uint8 tensor) ->
+    unbiased z-score, whole chain vs a torch re-execution of the
+    reference preprocess_particle body (dataLoader.py:147-167)."""
+    torch = pytest.importorskip("torch")
+
+    from repic_tpu.models import preprocess as pp
+
+    patches = rng.normal(0, 5, size=(4, 52, 52)).astype(np.float32)
+    got = np.asarray(pp.prepare_patches(patches, 64))
+
+    t = torch.from_numpy(patches).unsqueeze(1)
+    cmin = torch.amin(t, dim=(2, 3), keepdim=True)
+    cmax = torch.amax(t, dim=(2, 3), keepdim=True)
+    bytedata = (t - cmin) * (255.0 / (cmax - cmin))
+    bytedata = (torch.clip(bytedata, 0, 255) + 0.5).to(torch.uint8)
+    # torchvision F.resize on uint8: float interpolation, then
+    # round-half-to-even + clamp + cast back to uint8, then .float()
+    r = torch.nn.functional.interpolate(
+        bytedata.float(), size=(64, 64), mode="bilinear", antialias=True
+    )
+    r = r.round_().clamp_(0, 255).to(torch.uint8).float()
+    want = (
+        (r - torch.mean(r, dim=(2, 3), keepdim=True))
+        / torch.std(r, dim=(2, 3), keepdim=True)  # unbiased, ddof=1
+    ).squeeze(1).numpy()
+    np.testing.assert_allclose(got, want, atol=2e-4)

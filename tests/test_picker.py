@@ -74,7 +74,8 @@ def test_standardize_patches(rng):
     got = np.asarray(pp.standardize_patches(jnp.asarray(patches)))
     for g in got:
         assert abs(g.mean()) < 1e-5
-        assert abs(g.std() - 1) < 1e-4
+        # unbiased std (ddof=1), matching the reference's torch.std
+        assert abs(g.std(ddof=1) - 1) < 1e-4
 
 
 def test_preprocess_micrograph_shapes(rng):
