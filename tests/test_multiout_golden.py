@@ -243,3 +243,32 @@ def test_reference_multiout_labels_are_mislabeled():
         if (round(xy[0], 1), round(xy[1], 1)) not in raw[lab]
     )
     assert mislabeled > 0
+
+
+@needs_reference
+def test_cc_stats_match_reference(tmp_path):
+    """Largest-CC size and CC count in the runtime TSV, vs values the
+    executed reference printed for the same 2-micrograph subset
+    (reference get_cliques.py:146-149; columns: runtime, largest CC,
+    num CC)."""
+    from repic_tpu.commands import get_cliques
+
+    want = {NAMES[0]: (16, 563), NAMES[1]: (12, 525)}
+    out = str(tmp_path / "out")
+    get_cliques.main(
+        SimpleNamespace(
+            in_dir=_stage_subset(tmp_path),
+            out_dir=out,
+            box_size=180,
+            multi_out=False,
+            get_cc=False,
+            max_neighbors=16,
+            no_mesh=True,
+        )
+    )
+    for name, (largest, num) in want.items():
+        line = open(
+            os.path.join(out, name + "_runtime.tsv")
+        ).read().split()
+        assert int(float(line[1])) == largest, name
+        assert int(float(line[2])) == num, name
