@@ -272,3 +272,48 @@ def test_cc_stats_match_reference(tmp_path):
         ).read().split()
         assert int(float(line[1])) == largest, name
         assert int(float(line[2])) == num, name
+
+
+@needs_reference
+def test_get_cc_filter_matches_reference(tmp_path):
+    """--get_cc (keep only the largest connected component's cliques):
+    representative coordinates and weight sum vs the executed
+    reference on the same subset
+    (tests/golden/ref_getcc_10017_2mics.json)."""
+    from repic_tpu.commands import get_cliques
+
+    golden_path = os.path.join(
+        os.path.dirname(__file__), "golden", "ref_getcc_10017_2mics.json"
+    )
+    with open(golden_path) as f:
+        golden = json.load(f)
+    out = str(tmp_path / "out")
+    get_cliques.main(
+        SimpleNamespace(
+            in_dir=_stage_subset(tmp_path),
+            out_dir=out,
+            box_size=180,
+            multi_out=False,
+            get_cc=True,
+            max_neighbors=16,
+            no_mesh=True,
+        )
+    )
+    for name, gd in golden.items():
+        with open(
+            os.path.join(out, name + "_consensus_coords.pickle"), "rb"
+        ) as f:
+            coords = pickle.load(f)
+        with open(
+            os.path.join(out, name + "_weight_vector.pickle"), "rb"
+        ) as f:
+            w = np.asarray(pickle.load(f))
+        assert len(coords) == gd["n"], name
+        mine = sorted(
+            [round(float(c[0]), 3), round(float(c[1]), 3)]
+            for c in coords
+        )
+        assert mine == gd["rep_xy"], f"{name}: representative coords"
+        np.testing.assert_allclose(
+            float(np.sum(w)), gd["w_sum"], atol=2e-3, err_msg=name
+        )
