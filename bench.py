@@ -36,14 +36,33 @@ import time
 
 BASELINE_MICROGRAPHS_PER_SEC = 12 / (84.9 + 60.0)
 
-EXAMPLES = os.environ.get(
-    "REPIC_TPU_BENCH_DATA", "/root/reference/examples/10017"
-)
+def _default_examples() -> str:
+    """Prefer the in-repo real BOX set; fall back to the mount."""
+    here = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "examples", "10017"
+    )
+    if os.path.isdir(here):
+        return here
+    return "/root/reference/examples/10017"
+
+
+EXAMPLES = os.environ.get("REPIC_TPU_BENCH_DATA") or _default_examples()
 
 METRIC = "EMPIAR-10017 3-picker consensus (clique+ILP), end-to-end"
 
 CHILD_TIMEOUT_S = int(os.environ.get("REPIC_BENCH_TIMEOUT", "420"))
 PROBE_TIMEOUT_S = int(os.environ.get("REPIC_BENCH_PROBE_TIMEOUT", "75"))
+# Opportunistic retry cadence (round-2 verdict): a wedged TPU tunnel
+# is usually transient, so instead of one probe-and-give-up, keep
+# probing cheaply for up to this window before falling back to CPU.
+TPU_WAIT_S = int(os.environ.get("REPIC_BENCH_TPU_WAIT", "900"))
+PROBE_INTERVAL_S = int(os.environ.get("REPIC_BENCH_PROBE_INTERVAL", "45"))
+# Sidecar recording the last *successful* TPU measurement, so a wedge
+# at measurement time degrades to "stale TPU number + fresh CPU
+# number" instead of erasing the TPU evidence entirely.
+LAST_TPU_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_LAST.json"
+)
 
 
 def _synthesize(dst, n_micro=12, n_per=700, k=3, seed=0):
@@ -190,39 +209,83 @@ def _probe_default_platform() -> bool:
     return ok
 
 
+def _record_tpu_success(line: str) -> None:
+    """Persist the last healthy TPU measurement to the sidecar."""
+    try:
+        obj = json.loads(line)
+        if obj.get("platform") == "tpu":
+            obj["measured_at_unix"] = int(time.time())
+            with open(LAST_TPU_PATH, "wt") as f:
+                json.dump(obj, f)
+                f.write("\n")
+    except (OSError, ValueError):
+        pass
+
+
+def _last_tpu_record():
+    try:
+        with open(LAST_TPU_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def main():
     if "--child" in sys.argv:
         return run_measurement(force_cpu="--cpu" in sys.argv)
 
-    # 3 attempts on the default (TPU-preferring) platform with
-    # backoff — transient "TPU backend setup/compile error
-    # (Unavailable)" is exactly what round 1 died on.  Each attempt
-    # starts with a short-timeout device probe so a hung TPU tunnel
-    # costs ~75 s, not a full measurement timeout.
+    # Opportunistic retry cadence (round-2 verdict): the TPU tunnel
+    # wedges transiently, so probe cheaply on an interval for up to
+    # TPU_WAIT_S before conceding to CPU.  Each healthy probe earns
+    # one full measurement attempt; a measurement *timeout* (vs. a
+    # crash) means the tunnel wedged mid-run — keep probing until the
+    # window closes rather than giving up on the first hang.
     last_err = ""
-    for attempt in range(3):
+    deadline = time.time() + TPU_WAIT_S
+    attempt = 0
+    while time.time() < deadline:
         if not _probe_default_platform():
             last_err = "backend probe failed or hung"
-            break  # a dead/hung backend won't heal with backoff
+            remaining = deadline - time.time()
+            if remaining <= PROBE_INTERVAL_S:
+                break
+            print(
+                f"probe unhealthy; retrying in {PROBE_INTERVAL_S}s "
+                f"({int(remaining)}s left in TPU window)",
+                file=sys.stderr,
+                flush=True,
+            )
+            time.sleep(PROBE_INTERVAL_S)
+            continue
+        attempt += 1
         ok, line, err = _run_child(
             force_cpu=False, timeout_s=CHILD_TIMEOUT_S
         )
         if ok:
+            _record_tpu_success(line)
             print(line, flush=True)
             return 0
         last_err = err
         print(
-            f"bench attempt {attempt + 1} failed: {err[:400]}",
+            f"bench attempt {attempt} failed: {err[:400]}",
             file=sys.stderr,
             flush=True,
         )
-        if err.startswith("timeout"):
-            break  # a hang won't heal with backoff; go to CPU now
-        time.sleep(5 * (attempt + 1))
+        if attempt >= 3 and not err.startswith("timeout"):
+            break  # repeated real crashes won't heal with retries
+        time.sleep(5)
 
     print("falling back to CPU platform", file=sys.stderr, flush=True)
     ok, line, err = _run_child(force_cpu=True, timeout_s=CHILD_TIMEOUT_S)
     if ok:
+        # Attach the last healthy TPU measurement (if any) so a
+        # transient wedge degrades the artifact instead of erasing
+        # the TPU evidence.
+        prev = _last_tpu_record()
+        if prev is not None:
+            obj = json.loads(line)
+            obj["last_healthy_tpu"] = prev
+            line = json.dumps(obj)
         print(line, flush=True)
         return 0
 

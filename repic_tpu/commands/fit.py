@@ -21,7 +21,12 @@ name = "fit"
 
 
 def add_arguments(parser) -> None:
-    parser.add_argument("train_mrc_dir", help="training micrographs (.mrc)")
+    parser.add_argument(
+        "train_mrc_dir",
+        help="training micrographs (.mrc); with --source extracted "
+        "this is instead the base directory that the ';'-separated "
+        "patch-pickle paths are resolved against",
+    )
     parser.add_argument(
         "train_label_dir",
         help="training labels: a BOX/STAR directory (--source labels),"
@@ -65,7 +70,15 @@ def add_arguments(parser) -> None:
         "(1,100] top percent, >100 top count "
         "(reference train_number semantics)",
     )
-    parser.add_argument("--particle_size", type=int, required=True)
+    parser.add_argument(
+        "--particle_size",
+        type=int,
+        required=True,
+        help="particle edge length in pixels; --source extracted "
+        "consumes pre-cut patches so the value is not used for "
+        "patch cutting there, but it is still recorded in the "
+        "checkpoint metadata for inference",
+    )
     parser.add_argument("--batch_size", type=int, default=128)
     parser.add_argument("--max_epochs", type=int, default=200)
     parser.add_argument(

@@ -86,9 +86,10 @@ def _discover_labels(label_dir: str) -> dict[str, str]:
     A DeepPicker-style ``_deeppicker`` coordinate suffix before the
     extension is stripped when matching (run_deep.sh:27
     ``--coordinate_symbol _deeppicker``).  Resolution is
-    deterministic: exact-stem files beat suffix-stripped ones, BOX
-    beats STAR, and enumeration is sorted (glob order is
-    filesystem-dependent).
+    deterministic, with format outranking exactness: any BOX file
+    (exact or suffix-stripped) beats any STAR file for the same stem;
+    within one format an exact-stem file beats a suffix-stripped one;
+    and enumeration is sorted (glob order is filesystem-dependent).
     """
     out: dict[str, str] = {}
     for pattern in ("*.star", "*.box"):  # box overwrites star

@@ -112,8 +112,16 @@ def _neighbor_kernel(
     new_i = []
     for s in range(d):
         row_max = jnp.max(work_v, axis=1, keepdims=True)   # (TM, 1)
-        arg = jnp.argmax(work_v, axis=1)                   # (TM,)
-        sel = pos == arg[:, None]
+        # first position among the row maxima — explicit min-reduction
+        # rather than argmax: Mosaic's argmax tie-break differs from
+        # interpret mode's, and zero-IoU candidates form large tie
+        # classes (every valid non-overlapping pair has IoU == 0.0)
+        first = jnp.min(
+            jnp.where(work_v == row_max, pos, work_v.shape[1]),
+            axis=1,
+            keepdims=True,
+        )
+        sel = pos == first
         picked_i = jnp.sum(
             jnp.where(sel, work_i, 0), axis=1, keepdims=True
         )

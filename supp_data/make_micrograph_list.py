@@ -1,0 +1,78 @@
+#!/usr/bin/env python3
+"""Generate the supplementary micrograph-list file (supp file 1 analog).
+
+The reference ships ``supplemental_data_file_1.txt`` — a plain list of
+the micrograph filenames its paper analysis used, one ``.mrc`` name
+per line (reference supp_data_files/supplemental_data_file_1.txt; 460
+lines).  That exact list is a paper artifact tied to data this
+framework does not redistribute, but its *form* is reproducible from
+any dataset: this script emits the same one-name-per-line format from
+either a micrograph directory or a ``build_subsets`` output tree
+(in which case the split membership is listed per set, matching how
+the reference's list documents which micrographs entered the
+analysis).
+
+Usage:
+    python supp_data/make_micrograph_list.py <mrc_dir_or_subsets_dir> \
+        [-o supp_data/micrograph_list.txt]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+SPLITS = ("train", "val", "test")
+
+
+def collect(root: str) -> list[str]:
+    """Micrograph names from a build_subsets tree or a flat dir."""
+    if any(os.path.isdir(os.path.join(root, s)) for s in SPLITS):
+        names: list[str] = []
+        for split in SPLITS:
+            d = os.path.join(root, split)
+            if not os.path.isdir(d):
+                continue
+            # build_subsets trees nest size subsets under train/
+            for sub_root, _dirs, files in sorted(os.walk(d)):
+                mrcs = sorted(f for f in files if f.endswith(".mrc"))
+                if mrcs:
+                    rel = os.path.relpath(sub_root, root)
+                    names.append(f"# {rel}")
+                    names.extend(mrcs)
+                    break  # one listing per split, not per subset
+        return names
+    return sorted(
+        f for f in os.listdir(root) if f.endswith(".mrc")
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "root", help="micrograph directory or build_subsets output"
+    )
+    ap.add_argument(
+        "-o",
+        "--out",
+        default=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "micrograph_list.txt",
+        ),
+    )
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.root):
+        print(f"error: {args.root} is not a directory", file=sys.stderr)
+        return 1
+    names = collect(args.root)
+    with open(args.out, "wt") as f:
+        for n in names:
+            f.write(n + "\n")
+    print(f"wrote {sum(1 for n in names if not n.startswith('#'))} "
+          f"micrograph names to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,7 +40,21 @@ def rng():
     return np.random.default_rng(0)
 
 
-REFERENCE_EXAMPLES = "/root/reference/examples/10017"
+# Example-data discovery: the real EMPIAR-10017 BOX set (36 files,
+# reference README.md:48) is committed in-repo at examples/10017 so
+# the golden suite runs without the reference mount; the mount stays
+# as a fallback for layouts that predate the in-repo copy.
+_IN_REPO_EXAMPLES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "examples",
+    "10017",
+)
+_MOUNT_EXAMPLES = "/root/reference/examples/10017"
+REFERENCE_EXAMPLES = (
+    _IN_REPO_EXAMPLES
+    if os.path.isdir(_IN_REPO_EXAMPLES)
+    else _MOUNT_EXAMPLES
+)
 
 
 def reference_available() -> bool:
@@ -49,5 +63,5 @@ def reference_available() -> bool:
 
 needs_reference = pytest.mark.skipif(
     not reference_available(),
-    reason="reference example data not mounted",
+    reason="example data not found (neither in-repo nor mounted)",
 )

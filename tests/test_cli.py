@@ -274,9 +274,84 @@ def test_get_examples_accepts_matching_length(tmp_path, monkeypatch):
         "urlopen",
         lambda url, timeout=None: FakeResponse(b"hello"),
     )
-    n = get_examples._fetch(
+    n, digest = get_examples._fetch(
         "https://example/x.box", str(tmp_path / "x.box"), 5.0
     )
     assert n == 5
     assert (tmp_path / "x.box").read_bytes() == b"hello"
     assert get_examples.BUCKET.startswith("https://")
+    import hashlib
+
+    assert digest == hashlib.sha256(b"hello").hexdigest()
+
+
+def _fake_urlopen(payload: bytes):
+    import io
+
+    class FakeResponse(io.BytesIO):
+        headers = {"Content-Length": str(len(payload))}
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    return lambda url, timeout=None: FakeResponse(payload)
+
+
+def test_get_examples_rejects_sha256_mismatch(tmp_path, monkeypatch):
+    """A pinned digest must reject a same-length but altered payload
+    (Content-Length alone cannot — ADVICE r2)."""
+    from repic_tpu.commands import get_examples
+
+    monkeypatch.setattr(
+        get_examples.urllib.request, "urlopen", _fake_urlopen(b"EVIL!")
+    )
+    import hashlib
+
+    pinned = hashlib.sha256(b"good!").hexdigest()  # same length
+    with pytest.raises(get_examples.IntegrityError, match="sha256"):
+        get_examples._fetch(
+            "https://example/x.box", str(tmp_path / "x.box"), 5.0,
+            pinned=pinned,
+        )
+    assert not (tmp_path / "x.box").exists()
+
+
+def test_get_examples_update_manifest_pins_then_verifies(
+    tmp_path, monkeypatch
+):
+    """--update_manifest records digests (trust-on-first-use); a later
+    run against the pinned manifest rejects changed content."""
+    import hashlib
+
+    from repic_tpu.commands import get_examples
+
+    manifest = tmp_path / "manifest.json"
+    ex = tmp_path / "ex"
+    monkeypatch.setattr(
+        get_examples.urllib.request, "urlopen", _fake_urlopen(b"data1")
+    )
+    cli_main(
+        [
+            "get_examples", str(ex),
+            "--manifest", str(manifest), "--update_manifest",
+        ]
+    )
+    pinned = get_examples.load_manifest(str(manifest))
+    fname = get_examples.FILE_STEMS[0] + ".mrc"
+    assert pinned[fname] == hashlib.sha256(b"data1").hexdigest()
+    assert len(pinned) == 2 * len(get_examples.FILE_STEMS)
+
+    # content changed upstream -> pinned manifest rejects re-download
+    monkeypatch.setattr(
+        get_examples.urllib.request, "urlopen", _fake_urlopen(b"data2")
+    )
+    with pytest.raises(SystemExit, match="sha256"):
+        cli_main(
+            [
+                "get_examples", str(ex), "--force",
+                "--manifest", str(manifest),
+            ]
+        )

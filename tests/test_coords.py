@@ -239,12 +239,13 @@ def test_golden_convert_matches_executed_reference(tmp_path):
     golden_dir = os.path.join(
         os.path.dirname(__file__), "golden", "convert"
     )
-    src = (
-        "/root/reference/examples/10017/topaz/"
-        "Falcon_2012_06_12-14_33_35_0.box"
+    from tests.conftest import REFERENCE_EXAMPLES
+
+    src = os.path.join(
+        REFERENCE_EXAMPLES, "topaz", "Falcon_2012_06_12-14_33_35_0.box"
     )
     if not os.path.isfile(src):
-        pytest.skip("reference example data not mounted")
+        pytest.skip("example data not found")
     stem = "Falcon_2012_06_12-14_33_35_0"
 
     from repic_tpu.utils.coords import convert

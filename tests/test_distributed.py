@@ -23,6 +23,30 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def test_initialize_survives_private_module_removal(monkeypatch):
+    """The already-initialized guard reads the private
+    ``jax._src.distributed.global_state``; if a JAX refactor removes
+    that module, ``initialize`` must fall through to the public path,
+    not crash (ADVICE r2 — the fallback branch was untested)."""
+    import jax._src as jax_src
+
+    from repic_tpu.parallel import distributed
+
+    # Make both halves of ``from jax._src import distributed`` fail:
+    # the attribute lookup on the package and the submodule import.
+    monkeypatch.delattr(jax_src, "distributed")
+    monkeypatch.setitem(sys.modules, "jax._src.distributed", None)
+
+    # Single-process case: no coordinator configured -> no-op False.
+    for var in (
+        "JAX_COORDINATOR_ADDRESS",
+        "JAX_NUM_PROCESSES",
+        "JAX_PROCESS_ID",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    assert distributed.initialize() is False
+
+
 @pytest.mark.slow
 def test_two_process_consensus_matches_single(tmp_path):
     port = _free_port()
