@@ -70,12 +70,35 @@ def read_tsv_like(path) -> pd.DataFrame:
     """Whitespace-delimited table; leading non-numeric / ``_``-label
     lines are skipped and trailing all-non-numeric rows (CBOX footers)
     are dropped (reference: coord_converter.py:200-240)."""
-    skip = 0
+    skip = None
     with open(path, "rt") as f:
         for i, line in enumerate(f):
             if not line.startswith("_") and _has_digit(line):
                 skip = i
                 break
+    if skip is None:
+        # Header-only file: no data row exists.  A *tabular* header
+        # (e.g. topaz's "image_name x_coord y_coord score") still
+        # tokenizes — keep its positional columns so downstream
+        # remapping and geometry shifts see an empty-but-structured
+        # frame; a ragged STAR-style header (crYOLO --write_empty
+        # CBOX output, found by the stub-binary integration test)
+        # cannot be tokenized, so fall back to a structureless empty
+        # frame (cbox takes no geometry shift, so nothing downstream
+        # needs its columns).
+        try:
+            df = pd.read_csv(
+                path, sep=r"\s+", header=None, skip_blank_lines=True
+            )
+        except (pd.errors.EmptyDataError, pd.errors.ParserError):
+            return pd.DataFrame()
+        nonnumeric = df.apply(
+            lambda row: all(
+                not _is_float(v) for v in row.dropna()
+            ),
+            axis=1,
+        )
+        return df[~nonnumeric]
     try:
         df = pd.read_csv(
             path, sep=r"\s+", header=None, skip_blank_lines=True,
