@@ -58,6 +58,13 @@ def add_arguments(parser) -> None:
         "BOX files (reference --score branches)",
     )
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--no_resume",
+        action="store_true",
+        help="restart from round 0 even if a compatible state.json "
+        "from a previous run exists in the output directory "
+        "(by default completed rounds are not re-run)",
+    )
 
 
 def main(args) -> None:
@@ -88,6 +95,7 @@ def main(args) -> None:
             manual_label_dir=args.manual_label_dir,
             score_gt_dir=args.score,
             seed=args.seed,
+            resume=not args.no_resume,
         )
     except (ValueError, FileNotFoundError, PickerError) as e:
         sys.exit(f"error: {e}")

@@ -46,6 +46,7 @@ class IterativeState:
     out_dir: str
     rounds: list = field(default_factory=list)
     balance: float | None = None  # measured positive fraction
+    fingerprint: dict | None = None  # run parameters, guards resume
 
     def log(self, msg: str) -> None:
         stamp = time.strftime("%Y-%m-%d %H:%M:%S")
@@ -55,6 +56,89 @@ class IterativeState:
             os.path.join(self.out_dir, "iter_pick.log"), "at"
         ) as f:
             f.write(line + "\n")
+
+    def save(self) -> None:
+        """Atomically persist to ``state.json`` (written after every
+        completed round so a crashed multi-round run resumes instead
+        of retraining — the reference only leaves a manual hint,
+        run.sh:228-229)."""
+        path = os.path.join(self.out_dir, "state.json")
+        tmp = path + ".tmp"
+        with open(tmp, "wt") as f:
+            json.dump(
+                {
+                    "rounds": self.rounds,
+                    "balance": self.balance,
+                    "fingerprint": self.fingerprint,
+                },
+                f,
+                indent=2,
+            )
+        os.replace(tmp, path)
+
+
+def _run_fingerprint(
+    config, train_size, seed, semi_auto,
+    manual_label_dir, semi_auto_fraction,
+) -> dict:
+    """The parameters that must match for an on-disk run to be
+    resumable: anything that changes splits, labels, or geometry."""
+    return {
+        "data_dir": os.path.abspath(str(config["data_dir"])),
+        "box_size": int(config["box_size"]),
+        "train_size": int(train_size),
+        "seed": int(seed),
+        "semi_auto": bool(semi_auto),
+        # label-affecting parameters: rounds built from different
+        # manual labels, sampling fractions, or particle caps must
+        # not be mixed
+        "manual_label_dir": (
+            os.path.abspath(manual_label_dir)
+            if manual_label_dir
+            else None
+        ),
+        "semi_auto_fraction": float(semi_auto_fraction),
+        "exp_particles": int(config.get("exp_particles", 0)),
+    }
+
+
+def _load_resume_state(state: IterativeState) -> int:
+    """Load ``state.json`` from a previous run of the same
+    configuration; returns the number of completed rounds (0 = start
+    from scratch).  A fingerprint mismatch is logged and ignored —
+    the run restarts cleanly rather than mixing incompatible rounds."""
+    path = os.path.join(state.out_dir, "state.json")
+    try:
+        with open(path) as f:
+            prev = json.load(f)
+    except (OSError, ValueError):
+        return 0
+    if prev.get("fingerprint") != state.fingerprint:
+        state.log(
+            "state.json found but run parameters differ "
+            "(data_dir/box_size/train_size/seed/semi_auto); "
+            "starting from round 0"
+        )
+        return 0
+    rounds = prev.get("rounds") or []
+    # only trust rounds whose consensus outputs still exist on disk
+    usable = 0
+    for rec in rounds:
+        if all(
+            os.path.isdir(d) for d in rec.get("consensus", {}).values()
+        ) and len(rec.get("consensus", {})) == len(SPLITS):
+            usable += 1
+        else:
+            break
+    if usable:
+        state.rounds = rounds[:usable]
+        # balance as measured after the round actually resumed from —
+        # NOT the previous run's final value, which may belong to a
+        # later round whose outputs were discarded above
+        state.balance = rounds[usable - 1].get(
+            "balance", prev.get("balance")
+        )
+    return usable
 
 
 def _stem(path: str) -> str:
@@ -264,6 +348,7 @@ def run_iterative(
     score_gt_dir: str | None = None,
     seed: int = 0,
     picker_overrides: dict | None = None,
+    resume: bool = True,
 ) -> IterativeState:
     """The full iterative ensemble pipeline (run.sh's control flow).
 
@@ -282,9 +367,18 @@ def run_iterative(
             adapter (e.g. ``{"max_epochs": 5}`` for fast runs).
         score_gt_dir: if set, score every consensus stage against
             these ground-truth BOX files (run.sh --score branches).
+        resume: continue a previous run of the same configuration
+            from its last completed round (state.json is saved after
+            every round; the reference's run.sh only leaves a manual
+            resume hint, run.sh:228-229).
     """
     os.makedirs(out_dir, exist_ok=True)
     state = IterativeState(out_dir=out_dir)
+    state.fingerprint = _run_fingerprint(
+        config, train_size, seed, semi_auto,
+        manual_label_dir, semi_auto_fraction,
+    )
+    done_rounds = _load_resume_state(state) if resume else 0
     box_size = int(config["box_size"])
     exp_particles = int(config.get("exp_particles", 0))
 
@@ -305,37 +399,68 @@ def run_iterative(
         n = len(glob.glob(os.path.join(split_dirs[s], "*.mrc")))
         state.log(f"split {s}: {n} micrographs")
 
+    if done_rounds:
+        # ---- resume: skip completed rounds, restore picker models
+        # and the balance feedback from the last completed round
+        last = done_rounds - 1  # round index of the last record
+        state.log(
+            f"resuming: rounds 0..{last} already complete "
+            f"({len(state.rounds)} recorded in state.json)"
+        )
+        if last >= 1:
+            models_dir = os.path.join(
+                out_dir, f"round_{last}", "models"
+            )
+            for picker in pickers:
+                mpath = os.path.join(
+                    models_dir, f"{picker.name}.rptpu"
+                )
+                if os.path.exists(mpath):
+                    picker.model_path = mpath
+                    state.log(
+                        f"resume: {picker.name} model <- {mpath}"
+                    )
+        if state.balance is not None:
+            for p in pickers:
+                if hasattr(p, "balance"):
+                    p.balance = state.balance
+
     # ---- round 0
-    round_dir = os.path.join(out_dir, "round_0")
-    os.makedirs(round_dir, exist_ok=True)
-    if semi_auto:
-        if not manual_label_dir:
-            raise ValueError("semi_auto requires manual_label_dir")
-        consensus_dirs = seed_round0_from_manual(
-            manual_label_dir,
-            split_dirs,
-            round_dir,
-            fraction=semi_auto_fraction,
-            seed=seed,
-            box_size=box_size,
+    if not done_rounds:
+        round_dir = os.path.join(out_dir, "round_0")
+        os.makedirs(round_dir, exist_ok=True)
+        if semi_auto:
+            if not manual_label_dir:
+                raise ValueError("semi_auto requires manual_label_dir")
+            consensus_dirs = seed_round0_from_manual(
+                manual_label_dir,
+                split_dirs,
+                round_dir,
+                fraction=semi_auto_fraction,
+                seed=seed,
+                box_size=box_size,
+            )
+            state.log(
+                "round 0 seeded from sampled manual labels (semi-auto)"
+            )
+        else:
+            pred_dirs = predict_round(
+                pickers, split_dirs, round_dir, state
+            )
+            consensus_dirs = consensus_round(
+                pred_dirs,
+                round_dir,
+                box_size,
+                state,
+                num_particles=exp_particles or None,
+            )
+        _finish_round(
+            state, pickers, consensus_dirs, round_dir,
+            exp_particles, score_gt_dir, "round_0",
         )
-        state.log("round 0 seeded from sampled manual labels (semi-auto)")
-    else:
-        pred_dirs = predict_round(pickers, split_dirs, round_dir, state)
-        consensus_dirs = consensus_round(
-            pred_dirs,
-            round_dir,
-            box_size,
-            state,
-            num_particles=exp_particles or None,
-        )
-    _finish_round(
-        state, pickers, consensus_dirs, round_dir,
-        exp_particles, score_gt_dir, "round_0",
-    )
 
     # ---- rounds 1..N: fit -> predict -> consensus
-    for it in range(1, num_iter + 1):
+    for it in range(max(1, done_rounds), num_iter + 1):
         prev = state.rounds[-1]["consensus"]
         round_dir = os.path.join(out_dir, f"round_{it}")
         models_dir = os.path.join(round_dir, "models")
@@ -369,15 +494,7 @@ def run_iterative(
             exp_particles, score_gt_dir, f"round_{it}",
         )
 
-    with open(os.path.join(out_dir, "state.json"), "wt") as f:
-        json.dump(
-            {
-                "rounds": state.rounds,
-                "balance": state.balance,
-            },
-            f,
-            indent=2,
-        )
+    state.save()
     state.log("iterative picking complete")
     return state
 
@@ -399,7 +516,14 @@ def _finish_round(
             if hasattr(p, "balance"):
                 p.balance = state.balance
     _score_stage(state, consensus_dirs, score_gt_dir, tag)
-    state.rounds.append({"dir": round_dir, "consensus": consensus_dirs})
+    state.rounds.append(
+        {
+            "dir": round_dir,
+            "consensus": consensus_dirs,
+            "balance": state.balance,
+        }
+    )
+    state.save()  # checkpoint: this round survives a crash
 
 
 def _score_stage(state, consensus_dirs, gt_dir, tag):

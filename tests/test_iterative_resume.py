@@ -1,0 +1,183 @@
+"""Mid-run resume of the iterative pipeline (round-3 verdict item 6).
+
+The reference leaves only a manual resume hint
+(reference: repic/iterative_particle_picking/run.sh:228-229); here
+``state.json`` is checkpointed after every completed round and
+``run_iterative`` continues from the last one.  These tests drive the
+orchestrator with lightweight fake pickers that record every
+``fit``/``predict`` call, so "round 1 was NOT retrained" is asserted
+directly on the call log.
+"""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from repic_tpu.pipeline import iterative
+
+
+class FakePicker:
+    """Deterministic picker: same picks every call, records calls."""
+
+    def __init__(self, name, particle_size, calls):
+        self.name = name
+        self.particle_size = particle_size
+        self.model_path = None
+        self.calls = calls  # shared list of (picker, op, detail)
+
+    def predict(self, mrc_dir, out_box_dir):
+        os.makedirs(out_box_dir, exist_ok=True)
+        total = 0
+        for mrc in sorted(glob.glob(os.path.join(mrc_dir, "*.mrc"))):
+            stem = os.path.splitext(os.path.basename(mrc))[0]
+            with open(
+                os.path.join(out_box_dir, stem + ".box"), "wt"
+            ) as f:
+                # all fake pickers agree -> every pick survives
+                # consensus
+                for i in range(4):
+                    f.write(
+                        f"{100 + 90 * i}\t{120 + 90 * i}\t"
+                        f"{self.particle_size}\t{self.particle_size}"
+                        f"\t0.9\n"
+                    )
+                total += 4
+        self.calls.append((self.name, "predict", mrc_dir))
+        return total
+
+    def fit(self, train_mrc, train_box, val_mrc, val_box, model_out):
+        # record which model this round retrains FROM — the resume
+        # assertion that round-2 training starts from round-1's model
+        self.calls.append((self.name, "fit", self.model_path))
+        with open(model_out, "wt") as f:
+            f.write(f"model-{self.name}")
+        self.model_path = model_out
+
+
+@pytest.fixture
+def env(tmp_path, monkeypatch):
+    data_dir = tmp_path / "mrc"
+    data_dir.mkdir()
+    for i in range(8):
+        (data_dir / f"mic{i}.mrc").write_bytes(b"\x00" * 32)
+    calls = []
+    monkeypatch.setattr(
+        iterative.pickers_mod,
+        "build_pickers",
+        lambda config: [
+            FakePicker(n, int(config["box_size"]), calls)
+            for n in ("cryolo", "deep", "topaz")
+        ],
+    )
+    config = {"data_dir": str(data_dir), "box_size": 48}
+    return config, str(tmp_path / "run"), calls
+
+
+def _fits_per_round(calls):
+    return [c for c in calls if c[1] == "fit"]
+
+
+def test_resume_continues_without_retraining(env):
+    config, out_dir, calls = env
+
+    # phase 1: a 1-round run completes (simulating a 3-round run
+    # that died after round 1 — identical on-disk state)
+    state = iterative.run_iterative(
+        config, num_iter=1, train_size=100, out_dir=out_dir
+    )
+    assert len(state.rounds) == 2  # round_0 + round_1
+    fits_run1 = len(_fits_per_round(calls))
+    assert fits_run1 == 3  # 3 pickers x 1 retraining round
+    predicts_run1 = len([c for c in calls if c[1] == "predict"])
+
+    # phase 2: re-invoke asking for 3 rounds; rounds 0-1 must be
+    # skipped, rounds 2-3 run
+    calls.clear()
+    state2 = iterative.run_iterative(
+        config, num_iter=3, train_size=100, out_dir=out_dir
+    )
+    assert len(state2.rounds) == 4
+    fits = _fits_per_round(calls)
+    assert len(fits) == 6  # 3 pickers x rounds {2, 3} only
+    # the first retraining of the resumed run starts FROM the
+    # round-1 checkpoints restored off disk, not from scratch
+    round1_models = os.path.join(out_dir, "round_1", "models")
+    assert all(
+        f[2] == os.path.join(round1_models, f"{f[0]}.rptpu")
+        for f in fits[:3]
+    )
+    # predict count scales with rounds actually run: run 1 covered
+    # rounds {0, 1}, the resumed run covers rounds {2, 3} — same count
+    assert len([c for c in calls if c[1] == "predict"]) == predicts_run1
+    # resumed rounds recorded and checkpointed
+    saved = json.load(open(os.path.join(out_dir, "state.json")))
+    assert len(saved["rounds"]) == 4
+    assert "resuming: rounds 0..1 already complete" in open(
+        os.path.join(out_dir, "iter_pick.log")
+    ).read()
+
+
+def test_resume_noop_when_all_rounds_done(env):
+    config, out_dir, calls = env
+    iterative.run_iterative(
+        config, num_iter=1, train_size=100, out_dir=out_dir
+    )
+    calls.clear()
+    state = iterative.run_iterative(
+        config, num_iter=1, train_size=100, out_dir=out_dir
+    )
+    assert len(state.rounds) == 2
+    assert calls == []  # nothing re-run
+
+
+def test_fingerprint_mismatch_restarts(env):
+    config, out_dir, calls = env
+    iterative.run_iterative(
+        config, num_iter=1, train_size=100, out_dir=out_dir
+    )
+    calls.clear()
+    # a different seed changes the splits: resuming would mix
+    # incompatible rounds, so the run must restart from round 0
+    state = iterative.run_iterative(
+        config, num_iter=1, train_size=100, out_dir=out_dir, seed=7
+    )
+    assert len(state.rounds) == 2
+    assert len(_fits_per_round(calls)) == 3  # round 1 retrained
+
+
+def test_no_resume_flag_restarts(env):
+    config, out_dir, calls = env
+    iterative.run_iterative(
+        config, num_iter=1, train_size=100, out_dir=out_dir
+    )
+    calls.clear()
+    iterative.run_iterative(
+        config, num_iter=1, train_size=100, out_dir=out_dir,
+        resume=False,
+    )
+    assert len(_fits_per_round(calls)) == 3
+
+
+def test_resume_ignores_rounds_with_missing_outputs(env):
+    """A round whose consensus dirs were deleted is not trusted."""
+    import shutil
+
+    config, out_dir, calls = env
+    iterative.run_iterative(
+        config, num_iter=1, train_size=100, out_dir=out_dir
+    )
+    # wipe round 1's consensus output; state.json still lists it
+    shutil.rmtree(os.path.join(out_dir, "round_1", "consensus"))
+    calls.clear()
+    state = iterative.run_iterative(
+        config, num_iter=1, train_size=100, out_dir=out_dir
+    )
+    assert len(state.rounds) == 2
+    # round 0 intact -> skipped; round 1 re-run
+    assert len(_fits_per_round(calls)) == 3
+    assert any(
+        c[1] == "predict" for c in calls
+    )
