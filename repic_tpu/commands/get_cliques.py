@@ -150,11 +150,17 @@ def main(args):
             )
         )
     )
-    labels_b, node_mask_b = cc_fn(
+    labels_dev, node_mask_dev = cc_fn(
         jnp.asarray(batch.xy), jnp.asarray(batch.mask)
     )
-    labels_b = np.asarray(labels_b)
-    node_mask_b = np.asarray(node_mask_b)
+    # ONE device fetch for the whole result pytree + CC labels: the
+    # per-micrograph loop below must not pay a host<->device round
+    # trip per array per micrograph (same batching rationale as the
+    # fused path, pipeline/consensus.py:455-459 — at 1024 micrographs
+    # over a tunneled TPU, per-array fetches dominate wall clock).
+    res, labels_b, node_mask_b = jax.device_get(
+        (res, labels_dev, node_mask_dev)
+    )
 
     n_cap = batch.capacity
     # Global sequential particle ids across micrographs and pickers in
@@ -169,12 +175,12 @@ def main(args):
         id_base = [next_id + int(np.sum(counts[:p])) for p in range(k)]
         next_id += int(np.sum(counts))
 
-        valid = np.asarray(res.valid[i])
-        member_idx = np.asarray(res.member_idx[i])[valid]  # (n, K)
-        w = np.asarray(res.w[i])[valid]
-        conf = np.asarray(res.confidence[i])[valid]
-        rep_slot = np.asarray(res.rep_slot[i])[valid]
-        rep_xy = np.asarray(res.rep_xy[i])[valid]
+        valid = res.valid[i]
+        member_idx = res.member_idx[i][valid]  # (n, K)
+        w = res.w[i][valid]
+        conf = res.confidence[i][valid]
+        rep_slot = res.rep_slot[i][valid]
+        rep_xy = res.rep_xy[i][valid]
 
         if args.get_cc:
             keep_label = largest_component_label(
