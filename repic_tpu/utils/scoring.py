@@ -159,11 +159,11 @@ def get_segmentation_scores(
     return float(prec), float(rec), float(f1), float(pos_frac)
 
 
-def match_by_stem(gt_paths, pckr_paths):
+def match_by_stem(gt_paths, pckr_paths, gt_ext=".box", pckr_ext=".box"):
     """Pair GT and picker files by lower-cased stem, allowing picker
     suffixes (reference: score_detections.py:98-112)."""
-    gt_paths = [f for f in gt_paths if f.endswith(".box")]
-    pckr_paths = [f for f in pckr_paths if f.endswith(".box")]
+    gt_paths = [f for f in gt_paths if f.endswith(gt_ext)]
+    pckr_paths = [f for f in pckr_paths if f.endswith(pckr_ext)]
     pairs = []
     for g in gt_paths:
         stem = Path(g).stem.lower()
@@ -183,18 +183,37 @@ def score_box_files(
     mrc_w=None,
     mrc_h=None,
     verbose=False,
+    gt_fmt="box",
+    pckr_fmt="box",
+    box_size=None,
 ):
-    """Score every matched (ground truth, picker) BOX-file pair."""
+    """Score every matched (ground truth, picker) coordinate-file pair.
+
+    Either side may be in any converter-registry format (box, cbox,
+    star, tsv, cs) — inputs are routed through the same conversion
+    pipeline the ``convert`` command uses.  The reference scorer
+    consumes BOX only and tells the user to pre-convert
+    (reference: score_detections.py:53-56); here the conversion is
+    inline.  Centered formats (star/tsv/cs) need ``box_size`` for the
+    center->corner shift.
+    """
     from repic_tpu.utils.coords import convert
 
-    pairs = match_by_stem(gt_paths, pckr_paths)
+    pairs = match_by_stem(
+        gt_paths, pckr_paths,
+        gt_ext=f".{gt_fmt}", pckr_ext=f".{pckr_fmt}",
+    )
     assert len(pairs) > 0, (
         "No paired ground truth and picker particle sets found"
     )
     rows = []
     for stem, g, p in pairs:
-        gt_df = next(iter(convert([g], "box", "box", quiet=True).values()))
-        p_df = next(iter(convert([p], "box", "box", quiet=True).values()))
+        gt_df = next(iter(convert(
+            [g], gt_fmt, "box", boxsize=box_size, quiet=True
+        ).values()))
+        p_df = next(iter(convert(
+            [p], pckr_fmt, "box", boxsize=box_size, quiet=True
+        ).values()))
         for df in (gt_df, p_df):
             if "conf" not in df.columns:
                 df["conf"] = 1
@@ -240,6 +259,24 @@ def add_arguments(parser) -> None:
                         help="micrograph width (pixels)")
     parser.add_argument("--verbose", action="store_true")
     parser.add_argument("--out_dir", type=str, default=None)
+    # format routing through the converter registry (the reference
+    # scorer is BOX-only and tells the user to pre-convert,
+    # score_detections.py:53-56; here conversion is inline)
+    from repic_tpu.utils.coords import FORMATS
+
+    parser.add_argument(
+        "--gt_format", choices=sorted(FORMATS), default="box",
+        help="format of the ground-truth file(s) (default: box)",
+    )
+    parser.add_argument(
+        "--pckr_format", choices=sorted(FORMATS), default="box",
+        help="format of the picker file(s) (default: box)",
+    )
+    parser.add_argument(
+        "--box_size", type=int, default=None,
+        help="particle box size; required when a centered format "
+        "(star/tsv/cs) is scored",
+    )
 
 
 def main(args) -> None:
@@ -251,6 +288,8 @@ def main(args) -> None:
     rows = score_box_files(
         args.g, args.p, conf_thresh=args.c,
         mrc_w=args.width, mrc_h=args.height, verbose=args.verbose,
+        gt_fmt=args.gt_format, pckr_fmt=args.pckr_format,
+        box_size=args.box_size,
     )
     out_file = write_scores_tsv(rows, out_dir)
     if args.verbose:

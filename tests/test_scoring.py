@@ -193,3 +193,37 @@ def test_golden_scores_match_executed_reference():
             [precision, recall, f1, pos_frac], want, rtol=1e-6,
             err_msg=stem,
         )
+
+
+def test_star_gt_scored_against_box_picks(tmp_path):
+    """STAR ground truth + BOX picks through the format-routing CLI
+    (the reference scorer is BOX-only, score_detections.py:53-56)."""
+    gt_dir, p_dir = tmp_path / "gt", tmp_path / "p"
+    gt_dir.mkdir(), p_dir.mkdir()
+    # star is centered: center (20, 20) with box 20 -> corner (10, 10)
+    (gt_dir / "m1.star").write_text(
+        "data_\n\nloop_\n_rlnCoordinateX #1\n_rlnCoordinateY #2\n"
+        "_rlnAutopickFigureOfMerit #3\n20.0\t20.0\t1.0\n"
+    )
+    (p_dir / "m1.box").write_text("10\t10\t20\t20\t0.9\n")
+    from repic_tpu.main import build_parser
+
+    args = build_parser().parse_args(
+        [
+            "score",
+            "-g", str(gt_dir / "m1.star"),
+            "-p", str(p_dir / "m1.box"),
+            "--gt_format", "star",
+            "--box_size", "20",
+            "--out_dir", str(tmp_path / "out"),
+        ]
+    )
+    args.func(args)
+    lines = (
+        (tmp_path / "out" / "particle_set_comp.tsv")
+        .read_text().strip().splitlines()
+    )
+    vals = lines[1].split("\t")
+    assert vals[0] == "m1"
+    # identical geometry after the center->corner shift: perfect score
+    assert float(vals[1]) == 1.0 and float(vals[3]) == 1.0
