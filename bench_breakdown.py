@@ -141,13 +141,9 @@ def bench_headline(platform: str) -> dict:
     batch = pad_batch([(n, s) for n, s in loaded if s is not None])
     # seed the capacity config, then time the compiled fn directly
     run_consensus_batch(batch, 180.0, use_mesh=False)
-    from repic_tpu.pipeline.consensus import _LAST_GOOD_CONFIG
+    from repic_tpu.pipeline.consensus import last_good_config
 
-    (d, cap, cell_cap) = next(
-        v
-        for key, v in _LAST_GOOD_CONFIG.items()
-        if key[0] == batch.xy.shape
-    )
+    (d, cap, cell_cap) = last_good_config(batch.xy.shape)
     fn = make_batched_consensus(
         max_neighbors=d, clique_capacity=cap, mesh=None
     )
@@ -262,7 +258,7 @@ def bench_stress(platform: str, m: int = 4, n: int = 50_000, k: int = 4):
     from bench_stress import synthesize
     from repic_tpu.parallel.batching import PaddedBatch
     from repic_tpu.pipeline.consensus import (
-        _LAST_GOOD_CONFIG,
+        last_good_config,
         make_batched_consensus,
         run_consensus_batch,
     )
@@ -282,12 +278,7 @@ def bench_stress(platform: str, m: int = 4, n: int = 50_000, k: int = 4):
     first_s = time.time() - t0
 
     # recover the probed capacities and grid for direct timing
-    cfg_key = [
-        key
-        for key in _LAST_GOOD_CONFIG
-        if key[0] == batch.xy.shape and key[3]
-    ]
-    d, cap, cell_cap = _LAST_GOOD_CONFIG[cfg_key[0]]
+    d, cap, cell_cap = last_good_config(batch.xy.shape, spatial=True)
     extent = float(np.max(batch.xy)) + 180.0
     grid = grid_size(extent, 180.0)
     fn = make_batched_consensus(

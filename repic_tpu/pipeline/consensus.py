@@ -290,6 +290,28 @@ def _make_spatial_probe(grid: int, cell_capacity: int, threshold: float):
 _LAST_GOOD_CONFIG: dict = {}
 
 
+def last_good_config(xy_shape, spatial: bool | None = None):
+    """The recorded sufficient capacities ``(max_neighbors,
+    clique_capacity, cell_capacity)`` for a batch of this shape, from
+    the most recent :func:`run_consensus_batch` escalation.
+
+    ``spatial`` filters on the bucketed-path flag when not ``None``.
+    Raises ``RuntimeError`` (instead of a bare ``StopIteration`` from
+    callers poking the private dict) when no run has recorded a
+    config for the shape yet.
+    """
+    for key, v in _LAST_GOOD_CONFIG.items():
+        if key[0] == xy_shape and (
+            spatial is None or key[3] == spatial
+        ):
+            return v
+    raise RuntimeError(
+        f"no recorded capacity config for batch shape {xy_shape}"
+        + ("" if spatial is None else f" (spatial={spatial})")
+        + "; run run_consensus_batch on this workload first"
+    )
+
+
 def _next_pow2(x: int) -> int:
     # shared power-of-two bucketing policy (recompile-stable sizes)
     return bucket_size(int(x), minimum=2)

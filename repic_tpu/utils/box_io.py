@@ -114,6 +114,10 @@ def _read_box_fast(path: str) -> BoxSet:
         engine="c",
         keep_default_na=False,
         na_values=[],
+        # bit-identical to the slow path's float() by construction,
+        # not just empirically (pandas' default fast float parse can
+        # differ in the last ulp)
+        float_precision="round_trip",
     )
     arr = df.to_numpy(dtype=np.float64)[:, :5]  # extra cols ignored
     n, c = arr.shape

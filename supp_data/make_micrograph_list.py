@@ -34,14 +34,17 @@ def collect(root: str) -> list[str]:
             d = os.path.join(root, split)
             if not os.path.isdir(d):
                 continue
-            # build_subsets trees nest size subsets under train/
+            # build_subsets trees nest size subsets under train/ —
+            # list EVERY subset with its relpath header (breaking on
+            # the first .mrc-bearing dir picked whichever subset
+            # sorts first lexicographically, e.g. train/100 before
+            # train/25, which need not be the full membership)
             for sub_root, _dirs, files in sorted(os.walk(d)):
                 mrcs = sorted(f for f in files if f.endswith(".mrc"))
                 if mrcs:
                     rel = os.path.relpath(sub_root, root)
                     names.append(f"# {rel}")
                     names.extend(mrcs)
-                    break  # one listing per split, not per subset
         return names
     return sorted(
         f for f in os.listdir(root) if f.endswith(".mrc")
