@@ -184,13 +184,21 @@ def local_maxima_mask(score_map: jnp.ndarray, window: int):
     return (score_map == data_max) & (data_max - data_min > 0)
 
 
-def peak_detection(score_map: np.ndarray, window: int):
+def peak_detection(
+    score_map: np.ndarray, window: int, device_nms: bool | None = None
+):
     """Local maxima + raster-order greedy suppression.
 
     Mirrors the reference's semantics (autoPicker.py:62-131): plateau
     maxima are merged by connected-component center of mass, then
     candidate pairs closer than ``window / 2`` are resolved greedily
     in raster order, keeping the higher score.
+
+    The suppression stage is quadratic in candidates; on dense picks
+    it runs on the accelerator (``ops/nms.py``), bit-identical to the
+    host loop below, which remains the semantic specification (and
+    the low-latency path for small candidate sets).  ``device_nms``
+    forces the choice; ``None`` picks by candidate count.
 
     Returns:
         ``(P, 3)`` float array of (x, y, score) on the score-map grid.
@@ -208,12 +216,34 @@ def peak_detection(score_map: np.ndarray, window: int):
         ndimage.center_of_mass(score_map, labeled, range(1, num + 1))
     ).astype(int)
     scores = score_map[yx[:, 0], yx[:, 1]]
+    thr = window / 2.0
+
+    if device_nms is None:
+        from repic_tpu.ops.nms import COORD_LIMIT, DEVICE_NMS_MIN_P
+
+        # auto-select the device path only where it is exactly the
+        # host loop: enough candidates to amortize dispatch, grid
+        # small enough for exact int32 distances, and scores that
+        # round-trip through the device's float32
+        device_nms = (
+            len(yx) >= DEVICE_NMS_MIN_P
+            and yx.max(initial=0) < COORD_LIMIT
+            and np.array_equal(
+                scores, scores.astype(np.float32).astype(scores.dtype)
+            )
+        )
+    if device_nms:
+        from repic_tpu.ops.nms import greedy_suppress_device
+
+        keep = greedy_suppress_device(yx, scores, thr)
+        return np.column_stack(
+            [yx[keep, 1], yx[keep, 0], scores[keep]]
+        ).astype(np.float64)
 
     # Greedy raster-order suppression, O(P^2) pairwise like the
     # reference but vectorized over the inner loop.
     order = np.arange(len(yx))
     dead = np.zeros(len(yx), bool)
-    thr = window / 2.0
     for i in order[:-1]:
         if dead[i]:
             continue

@@ -1,0 +1,115 @@
+"""Device NMS vs the host greedy loop: bit-identical keep sets.
+
+The host loop in models/infer.peak_detection is the semantic
+specification (golden-gated against the executed reference in
+tests/test_deeppicker_golden.py); ops/nms.py re-expresses it as a
+device ``fori_loop``.  These tests sweep random clustered candidate
+sets — including score ties and chained kills — and require exact
+equality between the two paths.
+"""
+
+import numpy as np
+import pytest
+
+from repic_tpu.models.infer import peak_detection
+from repic_tpu.ops.nms import greedy_suppress_device
+
+
+def _host_keep(yx, scores, thr):
+    """The host loop, extracted verbatim semantics."""
+    order = np.arange(len(yx))
+    dead = np.zeros(len(yx), bool)
+    for i in order[:-1]:
+        if dead[i]:
+            continue
+        rest = order[i + 1:]
+        rest = rest[~dead[rest]]
+        if len(rest) == 0:
+            break
+        d = np.hypot(yx[i, 0] - yx[rest, 0], yx[i, 1] - yx[rest, 1])
+        close = rest[d < thr]
+        if len(close) == 0:
+            continue
+        stronger = scores[close] > scores[i]
+        if stronger.any():
+            cut = int(np.argmax(stronger))
+            dead[close[:cut]] = True
+            dead[i] = True
+        else:
+            dead[close] = True
+    return ~dead
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("n", [3, 50, 400])
+def test_device_matches_host_random(seed, n):
+    rng = np.random.default_rng(seed)
+    # clustered coordinates force dense conflict chains
+    centers = rng.integers(0, 120, size=(max(n // 8, 1), 2))
+    yx = (
+        centers[rng.integers(0, len(centers), n)]
+        + rng.integers(-4, 5, size=(n, 2))
+    ).clip(0)
+    scores = rng.standard_normal(n).astype(np.float32)
+    window = 7
+    thr = window / 2.0
+    got = greedy_suppress_device(yx, scores, thr)
+    want = _host_keep(yx, scores.astype(np.float64), thr)
+    assert np.array_equal(got, want)
+
+
+def test_device_matches_host_with_ties():
+    """Equal scores: later candidate is weaker-or-equal -> killed."""
+    yx = np.array([[0, 0], [0, 1], [0, 2], [10, 10]])
+    scores = np.array([1.0, 1.0, 2.0, 1.0], np.float32)
+    thr = 3.5 / 2
+    got = greedy_suppress_device(yx, scores, thr)
+    want = _host_keep(yx, scores, thr)
+    assert np.array_equal(got, want)
+
+
+def test_kill_chain_partial_survival():
+    """A stronger later neighbor kills i but spares i's later weak
+    neighbors beyond it (the reference's early-break semantics)."""
+    # i=0 sees j=1 (weaker: killed), j=2 (stronger: kills 0, stop);
+    # j=3 (weak, close to 0) must SURVIVE 0's pass and then lose to 2.
+    yx = np.array([[0, 0], [0, 1], [0, 2], [1, 0]])
+    scores = np.array([2.0, 1.0, 3.0, 1.5], np.float32)
+    thr = 5.0
+    want = _host_keep(yx, scores, thr)
+    got = greedy_suppress_device(yx, scores, thr)
+    assert np.array_equal(got, want)
+    assert want.tolist() == [False, False, True, False]
+
+
+def test_empty_and_single():
+    assert greedy_suppress_device(
+        np.zeros((0, 2), int), np.zeros(0), 2.0
+    ).shape == (0,)
+    assert greedy_suppress_device(
+        np.array([[5, 5]]), np.array([1.0]), 2.0
+    ).tolist() == [True]
+
+
+def test_peak_detection_device_flag_equivalence():
+    """Full peak_detection with device_nms forced on == host path."""
+    rng = np.random.default_rng(3)
+    smap = rng.random((80, 80)).astype(np.float32)
+    # smooth to create plateaus and realistic maxima
+    k = np.ones((3, 3)) / 9.0
+    from scipy import ndimage
+
+    smap = ndimage.convolve(smap, k, mode="nearest")
+    host = peak_detection(smap, window=5, device_nms=False)
+    dev = peak_detection(smap, window=5, device_nms=True)
+    assert np.allclose(host, dev)
+
+
+def test_coordinate_limit_guard():
+    """Grids beyond the exact-int32 bound refuse the device path
+    (and peak_detection's auto mode must route them to the host)."""
+    from repic_tpu.ops.nms import COORD_LIMIT
+
+    yx = np.array([[0, 0], [COORD_LIMIT + 10, 0]])
+    with pytest.raises(ValueError, match="host path"):
+        greedy_suppress_device(yx, np.array([1.0, 2.0]), 2.0)
