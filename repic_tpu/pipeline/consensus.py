@@ -504,6 +504,43 @@ def write_consensus_boxes(
     return counts
 
 
+def _is_oom_error(e: Exception) -> bool:
+    s = str(e).lower()
+    return "out of memory" in s or "resource_exhausted" in s
+
+
+def _auto_chunk(n_loaded: int, k: int, nb: int, n_dev: int) -> int:
+    """Initial micrograph-chunk size for :func:`run_consensus_dir`.
+
+    Bounded by a device/host memory budget against the dense-path
+    IoU intermediates (~3 live K x K x Nb x Nb f32 stages); the
+    K-1-way clique candidate product is data-dependent (neighbor
+    degree), so it cannot be estimated up front — the adaptive
+    OOM-halving loop in run_consensus_dir is the backstop for it.
+    Always a multiple of the mesh data axis (sharding must divide the
+    batch dimension), power-of-two in the auto path so every chunk
+    pads to the same shape and XLA compiles the program once, and
+    clamped to the workload size (rounded up to the axis).
+    """
+
+    def _axis_multiple(c: int) -> int:
+        return max(-(-c // n_dev) * n_dev, n_dev)
+
+    cap = _axis_multiple(n_loaded)
+    explicit = os.environ.get("REPIC_CONSENSUS_CHUNK")
+    if explicit:
+        return min(_axis_multiple(max(int(explicit), 1)), cap)
+    budget = float(
+        os.environ.get("REPIC_CONSENSUS_CHUNK_BYTES", 4e9)
+    )
+    per_micrograph = 3.0 * k * k * nb * nb * 4
+    chunk = max(int(budget // max(per_micrograph, 1.0)), 1)
+    c = 1
+    while c * 2 <= chunk:
+        c *= 2
+    return min(_axis_multiple(c), cap)
+
+
 def run_consensus_dir(
     in_dir: str,
     out_dir: str,
@@ -522,6 +559,15 @@ def run_consensus_dir(
     Directory layout matches the reference (``in_dir/<picker>/*.box``,
     reference: get_cliques.py:81-105); micrographs missing from any
     picker get an empty output file (get_cliques.py:123-130).
+
+    Large directories are processed in fixed-shape micrograph chunks
+    (one XLA compile, many executions): one batch over 1024
+    micrographs can need terabytes of dense-path intermediates.  The
+    initial chunk size comes from a memory-budget estimate
+    (``REPIC_CONSENSUS_CHUNK_BYTES``, default 4 GB, or explicit
+    ``REPIC_CONSENSUS_CHUNK``); a chunk that still exhausts device
+    memory is retried at half size — the memory analog of the
+    capacity-escalation ladder in :func:`run_consensus_batch`.
     """
     import shutil
 
@@ -583,33 +629,80 @@ def run_consensus_dir(
 
     timer.stages.append(("load", time.time() - t0))
     n_dev = len(jax.devices()) if use_mesh else 1
-    batch = pad_batch(loaded, pad_micrographs_to=n_dev)
-    t1 = time.time()
-    with timer.stage("compute"), annotate("consensus_batch"):
-        res = run_consensus_batch(
-            batch,
-            box_size,
-            threshold=threshold,
-            max_neighbors=max_neighbors,
-            use_mesh=use_mesh,
-            spatial=spatial,
-            solver=solver,
-            use_pallas=use_pallas,
+    k = len(pickers)
+    nb = bucket_size(
+        max(bs.n for _, sets in loaded for bs in sets)
+    )
+    chunk = _auto_chunk(len(loaded), k, nb, n_dev)
+
+    # One loop serves both regimes.  When the chunk covers the whole
+    # workload, padding sticks to the mesh axis (the historical
+    # single-batch shapes, so recorded capacity configs and compiled
+    # programs stay valid); otherwise every chunk pads to the same
+    # fixed shape -> one compile, many executions.  A chunk that
+    # exhausts device memory is halved and retried — the memory
+    # analog of the capacity-escalation ladder above, catching the
+    # data-dependent candidate-product blowups the static estimate
+    # cannot see.
+    compute_s = 0.0
+    write_s = 0.0
+    counts: dict = {}
+    num_cliques = 0
+    i = 0
+    while i < len(loaded):
+        single = chunk >= len(loaded)
+        part = loaded[i : i + chunk]
+        cbatch = pad_batch(
+            part,
+            pad_micrographs_to=n_dev if single else chunk,
+            capacity=nb,
         )
-        jax.block_until_ready(res.picked)
-    t2 = time.time()
-    with timer.stage("write"):
-        counts = write_consensus_boxes(
-            batch, res, out_dir, box_size, num_particles=num_particles
+        t1 = time.time()
+        try:
+            with annotate("consensus_batch"):
+                res = run_consensus_batch(
+                    cbatch,
+                    box_size,
+                    threshold=threshold,
+                    max_neighbors=max_neighbors,
+                    use_mesh=use_mesh,
+                    spatial=spatial,
+                    solver=solver,
+                    use_pallas=use_pallas,
+                )
+                jax.block_until_ready(res.picked)
+        except Exception as e:  # noqa: BLE001 — filtered to OOM below
+            if _is_oom_error(e) and chunk > n_dev:
+                chunk = max(
+                    -(-(chunk // 2) // n_dev) * n_dev, n_dev
+                )
+                print(
+                    "consensus chunk exhausted device memory; "
+                    f"retrying at {chunk} micrographs/chunk"
+                )
+                continue
+            raise
+        compute_s += time.time() - t1
+        t2 = time.time()
+        counts.update(
+            write_consensus_boxes(
+                cbatch, res, out_dir, box_size,
+                num_particles=num_particles,
+            )
         )
-    # per-run runtime TSV, the reference's observability surface
-    # (get_cliques.py:224-229 / run_ilp.py:132-136)
+        write_s += time.time() - t2
+        num_cliques += int(np.sum(np.asarray(res.num_cliques)))
+        i += len(part)
+    timer.stages.append(("compute", compute_s))
+    timer.stages.append(("write", write_s))
     timer.write_tsv(out_dir, "consensus_runtime.tsv")
     stats.update(
-        compute_s=t2 - t1,
-        write_s=time.time() - t2,
+        compute_s=compute_s,
+        write_s=write_s,
         total_s=time.time() - t0,
         particle_counts=counts,
-        num_cliques=int(np.sum(np.asarray(res.num_cliques))),
+        num_cliques=num_cliques,
     )
+    if chunk < len(loaded):
+        stats["chunk"] = chunk
     return stats

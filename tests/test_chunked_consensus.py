@@ -1,0 +1,103 @@
+"""Memory-bounded micrograph chunking in run_consensus_dir.
+
+One batch over 1024 micrographs can need terabytes of dense-path
+intermediates (found running bench_breakdown's batch1024 workload:
+an 8.9 TB allocation), so large directories are processed in
+fixed-shape chunks with OOM-halving as backstop.  Chunked output
+must be byte-identical to the single-batch path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from repic_tpu.pipeline.consensus import _auto_chunk, run_consensus_dir
+
+
+def _make_dir(tmp_path, m=5, k=3, n=40, seed=0):
+    rng = np.random.default_rng(seed)
+    d = tmp_path / "picks"
+    for p in range(k):
+        (d / f"picker{p}").mkdir(parents=True)
+    for i in range(m):
+        base = rng.uniform(50, 950, size=(n, 2))
+        for p in range(k):
+            jit = rng.normal(0, 10, size=base.shape)
+            conf = rng.uniform(0.1, 1.0, size=n)
+            with open(d / f"picker{p}" / f"mic{i}.box", "wt") as f:
+                for (x, y), c in zip(base + jit, conf):
+                    f.write(f"{x:.2f}\t{y:.2f}\t64\t64\t{c:.4f}\n")
+    return str(d)
+
+
+def _read_all(out):
+    return {
+        f: open(os.path.join(out, f)).read()
+        for f in sorted(os.listdir(out))
+        if f.endswith(".box")
+    }
+
+
+def test_chunked_equals_single_batch(tmp_path, monkeypatch):
+    data = _make_dir(tmp_path)
+    out_single = str(tmp_path / "single")
+    out_chunked = str(tmp_path / "chunked")
+
+    stats1 = run_consensus_dir(data, out_single, 64, use_mesh=False)
+    monkeypatch.setenv("REPIC_CONSENSUS_CHUNK", "2")
+    stats2 = run_consensus_dir(data, out_chunked, 64, use_mesh=False)
+
+    assert stats2.get("chunk") == 2  # chunked path actually ran
+    assert stats1["num_cliques"] == stats2["num_cliques"]
+    assert stats1["particle_counts"] == stats2["particle_counts"]
+    assert _read_all(out_single) == _read_all(out_chunked)
+
+
+def test_chunked_respects_mesh_axis(tmp_path, monkeypatch):
+    """Chunks stay multiples of the mesh data axis (8 CPU devices in
+    the test harness), so sharded runs chunk too."""
+    data = _make_dir(tmp_path, m=10)
+    out = str(tmp_path / "mesh_chunked")
+    monkeypatch.setenv("REPIC_CONSENSUS_CHUNK", "2")
+    stats = run_consensus_dir(data, out, 64, use_mesh=True)
+    # 2 < n_dev=8: clamped up to the mesh axis
+    assert stats.get("chunk") in (None, 8)
+    assert len(_read_all(out)) == 10
+
+
+def test_auto_chunk_estimator():
+    # small workload: chunk covers everything -> single batch
+    assert _auto_chunk(12, 3, 1024, 1) >= 12
+    # batch1024-scale dense workload: bounded well below 1024
+    c = _auto_chunk(1024, 5, 1024, 1)
+    assert 1 <= c < 1024
+    # never below the mesh axis
+    assert _auto_chunk(1024, 5, 65536, 8) == 8
+
+
+def test_oom_halving(tmp_path, monkeypatch):
+    """A chunk that exhausts memory is retried at half size."""
+    import repic_tpu.pipeline.consensus as C
+
+    data = _make_dir(tmp_path, m=8)
+    out = str(tmp_path / "oom")
+    monkeypatch.setenv("REPIC_CONSENSUS_CHUNK", "8")
+
+    real = C.run_consensus_batch
+    calls = []
+
+    def fake(batch, *a, **k):
+        calls.append(batch.xy.shape[0])
+        if batch.xy.shape[0] > 2:
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory")
+        return real(batch, *a, **k)
+
+    monkeypatch.setattr(C, "run_consensus_batch", fake)
+    # chunk must also be < len(loaded) for the chunked path: 8 == m
+    # means single-batch; force 4 then fake-OOM down to 2
+    monkeypatch.setenv("REPIC_CONSENSUS_CHUNK", "4")
+    stats = C.run_consensus_dir(data, out, 64, use_mesh=False)
+    assert stats["chunk"] == 2
+    assert calls[0] == 4 and 2 in calls
+    assert len(_read_all(out)) == 8
