@@ -100,8 +100,6 @@ def main(args):
         connected_component_labels,
         largest_component_label,
     )
-    from repic_tpu.parallel.batching import pad_batch
-    from repic_tpu.pipeline.consensus import run_consensus_batch
 
     assert os.path.exists(args.in_dir), "Error - input directory does not exist"
     if os.path.isdir(args.out_dir):
@@ -133,16 +131,10 @@ def main(args):
 
     import jax
 
-    n_dev = 1 if args.no_mesh else len(jax.devices())
-    batch = pad_batch(loaded, pad_micrographs_to=n_dev)
-    res = run_consensus_batch(
-        batch,
-        args.box_size,
-        max_neighbors=args.max_neighbors,
-        use_mesh=not args.no_mesh,
-    )
+    from repic_tpu.pipeline.consensus import iter_consensus_chunks
 
-    # CC labels for --get_cc and the runtime-TSV stats.
+    n_dev = 1 if args.no_mesh else len(jax.devices())
+
     cc_fn = jax.jit(
         jax.vmap(
             lambda xy, mask: connected_component_labels(
@@ -150,128 +142,138 @@ def main(args):
             )
         )
     )
-    labels_dev, node_mask_dev = cc_fn(
-        jnp.asarray(batch.xy), jnp.asarray(batch.mask)
-    )
-    # ONE device fetch for the whole result pytree + CC labels: the
-    # per-micrograph loop below must not pay a host<->device round
-    # trip per array per micrograph (same batching rationale as the
-    # fused path, pipeline/consensus.py:455-459 — at 1024 micrographs
-    # over a tunneled TPU, per-array fetches dominate wall clock).
-    res, labels_b, node_mask_b = jax.device_get(
-        (res, labels_dev, node_mask_dev)
-    )
 
-    n_cap = batch.capacity
     # Global sequential particle ids across micrographs and pickers in
     # processing order — the deterministic replacement for the
     # reference's mutable ``box_id`` counter (common.py:23).
     next_id = 0
-    per_micro_runtime = (time.time() - t_start) / max(len(loaded), 1)
+    per_micro_load = (time.time() - t_start) / max(len(loaded), 1)
 
-    for i, (mname, sets) in enumerate(loaded):
-        t0 = time.time()
-        counts = [s.n for s in sets]
-        id_base = [next_id + int(np.sum(counts[:p])) for p in range(k)]
-        next_id += int(np.sum(counts))
+    # Chunked to bound device memory (the shared engine behind the
+    # fused path); ONE device fetch per chunk for the result pytree +
+    # CC labels, so the per-micrograph loop never pays a host<->device
+    # round trip per array (at 1024 micrographs over a tunneled TPU,
+    # per-array fetches dominate wall clock).
+    for part, _batch, res, cc, chunk_s in iter_consensus_chunks(
+        loaded,
+        args.box_size,
+        n_dev=n_dev,
+        max_neighbors=args.max_neighbors,
+        use_mesh=not args.no_mesh,
+        extra_device_outputs=lambda b: cc_fn(
+            jnp.asarray(b.xy), jnp.asarray(b.mask)
+        ),
+        fetch=True,
+    ):
+        labels_b, node_mask_b = cc
+        # amortize this chunk's device compute into its micrographs'
+        # runtime column (the reference's runtime.tsv carries the full
+        # per-micrograph cost; run_ilp appends phase-2 runtime to the
+        # same file)
+        per_micro_runtime = per_micro_load + chunk_s / max(len(part), 1)
+        for i, (mname, sets) in enumerate(part):
+            t0 = time.time()
+            counts = [s.n for s in sets]
+            id_base = [next_id + int(np.sum(counts[:p])) for p in range(k)]
+            next_id += int(np.sum(counts))
 
-        valid = res.valid[i]
-        member_idx = res.member_idx[i][valid]  # (n, K)
-        w = res.w[i][valid]
-        conf = res.confidence[i][valid]
-        rep_slot = res.rep_slot[i][valid]
-        rep_xy = res.rep_xy[i][valid]
+            valid = res.valid[i]
+            member_idx = res.member_idx[i][valid]  # (n, K)
+            w = res.w[i][valid]
+            conf = res.confidence[i][valid]
+            rep_slot = res.rep_slot[i][valid]
+            rep_xy = res.rep_xy[i][valid]
 
-        if args.get_cc:
-            keep_label = largest_component_label(
-                labels_b[i], node_mask_b[i]
-            )
-            anchor_labels = labels_b[i][0, member_idx[:, 0]]
-            keep = anchor_labels == keep_label
-            member_idx, w, conf = member_idx[keep], w[keep], conf[keep]
-            rep_slot, rep_xy = rep_slot[keep], rep_xy[keep]
-
-        n = len(w)
-        num_cc, max_cc, _ = component_stats(labels_b[i], node_mask_b[i])
-
-        # Vertex ids in the reference identity space.
-        node_id = member_idx + np.asarray(id_base)[None, :]  # (n, K)
-        node_xy = np.stack(
-            [sets[p].xy[member_idx[:, p]] for p in range(k)], axis=1
-        )  # (n, K, 2)
-
-        if args.multi_out:
-            coords_out = [list(pickers)]
-            for c in range(n):
-                coords_out.append(
-                    _vertex_tuples(node_id[c], node_xy[c])
+            if args.get_cc:
+                keep_label = largest_component_label(
+                    labels_b[i], node_mask_b[i]
                 )
-            if not args.get_cc:
-                for p in range(k):
-                    present = (
-                        np.unique(member_idx[:, p])
-                        if n
-                        else np.empty(0, np.int64)
+                anchor_labels = labels_b[i][0, member_idx[:, 0]]
+                keep = anchor_labels == keep_label
+                member_idx, w, conf = member_idx[keep], w[keep], conf[keep]
+                rep_slot, rep_xy = rep_slot[keep], rep_xy[keep]
+
+            n = len(w)
+            num_cc, max_cc, _ = component_stats(labels_b[i], node_mask_b[i])
+
+            # Vertex ids in the reference identity space.
+            node_id = member_idx + np.asarray(id_base)[None, :]  # (n, K)
+            node_xy = np.stack(
+                [sets[p].xy[member_idx[:, p]] for p in range(k)], axis=1
+            )  # (n, K, 2)
+
+            if args.multi_out:
+                coords_out = [list(pickers)]
+                for c in range(n):
+                    coords_out.append(
+                        _vertex_tuples(node_id[c], node_xy[c])
                     )
-                    for j in np.setdiff1d(
-                        np.arange(counts[p]), present
-                    ):
-                        entry = [None] * k
-                        entry[p] = (
-                            float(sets[p].xy[j, 0]),
-                            float(sets[p].xy[j, 1]),
-                            int(id_base[p] + j),
+                if not args.get_cc:
+                    for p in range(k):
+                        present = (
+                            np.unique(member_idx[:, p])
+                            if n
+                            else np.empty(0, np.int64)
                         )
-                        coords_out.append(entry)
-        else:
-            rep_particle = member_idx[np.arange(n), rep_slot]
-            rep_ids = np.asarray(id_base)[rep_slot] + rep_particle
-            coords_out = _vertex_tuples(rep_ids, rep_xy)
+                        for j in np.setdiff1d(
+                            np.arange(counts[p]), present
+                        ):
+                            entry = [None] * k
+                            entry[p] = (
+                                float(sets[p].xy[j, 0]),
+                                float(sets[p].xy[j, 1]),
+                                int(id_base[p] + j),
+                            )
+                            coords_out.append(entry)
+            else:
+                rep_particle = member_idx[np.arange(n), rep_slot]
+                rep_ids = np.asarray(id_base)[rep_slot] + rep_particle
+                coords_out = _vertex_tuples(rep_ids, rep_xy)
 
-        # Constraint matrix over sorted participating vertices
-        # (reference sorts (x, y, id) tuples — get_cliques.py:164).
-        # Vectorized: np.unique(axis=0) sorts rows lexicographically,
-        # which equals sorted() on the (x, y, id) tuples; the inverse
-        # map IS the row index of each (clique, picker) entry.  The
-        # per-clique Python loop this replaces dominated host time at
-        # stress scale (50k cliques x K entries per micrograph).
-        entries = np.concatenate(
-            [
-                node_xy.reshape(n * k, 2).astype(np.float64),
-                node_id.reshape(n * k, 1).astype(np.float64),
-            ],
-            axis=1,
-        )
-        uniq, inverse = np.unique(entries, axis=0, return_inverse=True)
-        n_vertices = len(uniq)
-        cols = np.repeat(np.arange(n, dtype=np.int64), k)
-        a_mat = coo_matrix(
-            (np.ones(n * k, np.int64), (inverse.reshape(-1), cols)),
-            shape=(n_vertices, n),
-        )
-        print(f"--- {mname}: {n} cliques, {n_vertices} vertices")
-
-        for label, val in zip(
-            [
-                "weight_vector",
-                "consensus_coords",
-                "consensus_confidences",
-                "constraint_matrix",
-            ],
-            [w.astype(np.float32), coords_out, conf.astype(np.float32), a_mat],
-        ):
-            with open(
-                os.path.join(args.out_dir, f"{mname}_{label}.pickle"), "wb"
-            ) as o:
-                pickle.dump(val, o, protocol=pickle.HIGHEST_PROTOCOL)
-
-        with open(
-            os.path.join(args.out_dir, f"{mname}_runtime.tsv"), "wt"
-        ) as o:
-            runtime = per_micro_runtime + (time.time() - t0)
-            o.write(
-                "\t".join(str(v) for v in [runtime, max_cc, num_cc]) + "\n"
+            # Constraint matrix over sorted participating vertices
+            # (reference sorts (x, y, id) tuples — get_cliques.py:164).
+            # Vectorized: np.unique(axis=0) sorts rows lexicographically,
+            # which equals sorted() on the (x, y, id) tuples; the inverse
+            # map IS the row index of each (clique, picker) entry.  The
+            # per-clique Python loop this replaces dominated host time at
+            # stress scale (50k cliques x K entries per micrograph).
+            entries = np.concatenate(
+                [
+                    node_xy.reshape(n * k, 2).astype(np.float64),
+                    node_id.reshape(n * k, 1).astype(np.float64),
+                ],
+                axis=1,
             )
+            uniq, inverse = np.unique(entries, axis=0, return_inverse=True)
+            n_vertices = len(uniq)
+            cols = np.repeat(np.arange(n, dtype=np.int64), k)
+            a_mat = coo_matrix(
+                (np.ones(n * k, np.int64), (inverse.reshape(-1), cols)),
+                shape=(n_vertices, n),
+            )
+            print(f"--- {mname}: {n} cliques, {n_vertices} vertices")
+
+            for label, val in zip(
+                [
+                    "weight_vector",
+                    "consensus_coords",
+                    "consensus_confidences",
+                    "constraint_matrix",
+                ],
+                [w.astype(np.float32), coords_out, conf.astype(np.float32), a_mat],
+            ):
+                with open(
+                    os.path.join(args.out_dir, f"{mname}_{label}.pickle"), "wb"
+                ) as o:
+                    pickle.dump(val, o, protocol=pickle.HIGHEST_PROTOCOL)
+
+            with open(
+                os.path.join(args.out_dir, f"{mname}_runtime.tsv"), "wt"
+            ) as o:
+                runtime = per_micro_runtime + (time.time() - t0)
+                o.write(
+                    "\t".join(str(v) for v in [runtime, max_cc, num_cc]) + "\n"
+                )
 
 
 if __name__ == "__main__":

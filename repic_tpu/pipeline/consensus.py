@@ -633,21 +633,93 @@ def run_consensus_dir(
     nb = bucket_size(
         max(bs.n for _, sets in loaded for bs in sets)
     )
-    chunk = _auto_chunk(len(loaded), k, nb, n_dev)
-
-    # One loop serves both regimes.  When the chunk covers the whole
-    # workload, padding sticks to the mesh axis (the historical
-    # single-batch shapes, so recorded capacity configs and compiled
-    # programs stay valid); otherwise every chunk pads to the same
-    # fixed shape -> one compile, many executions.  A chunk that
-    # exhausts device memory is halved and retried — the memory
-    # analog of the capacity-escalation ladder above, catching the
-    # data-dependent candidate-product blowups the static estimate
-    # cannot see.
     compute_s = 0.0
     write_s = 0.0
     counts: dict = {}
     num_cliques = 0
+    parts = []
+    for part, cbatch, res, _extra, chunk_s in iter_consensus_chunks(
+        loaded,
+        box_size,
+        n_dev=n_dev,
+        threshold=threshold,
+        max_neighbors=max_neighbors,
+        use_mesh=use_mesh,
+        spatial=spatial,
+        solver=solver,
+        use_pallas=use_pallas,
+    ):
+        parts.append(len(part))
+        compute_s += chunk_s
+        t2 = time.time()
+        counts.update(
+            write_consensus_boxes(
+                cbatch, res, out_dir, box_size,
+                num_particles=num_particles,
+            )
+        )
+        write_s += time.time() - t2
+        num_cliques += int(np.sum(np.asarray(res.num_cliques)))
+    timer.stages.append(("compute", compute_s))
+    timer.stages.append(("write", write_s))
+    timer.write_tsv(out_dir, "consensus_runtime.tsv")
+    stats.update(
+        compute_s=compute_s,
+        write_s=write_s,
+        total_s=time.time() - t0,
+        particle_counts=counts,
+        num_cliques=num_cliques,
+    )
+    if len(parts) > 1:
+        stats["chunk"] = max(parts)
+    return stats
+
+
+def iter_consensus_chunks(
+    loaded,
+    box_size,
+    *,
+    n_dev: int = 1,
+    threshold: float = DEFAULT_THRESHOLD,
+    max_neighbors: int = 16,
+    use_mesh: bool = True,
+    spatial: bool | None = None,
+    solver: str = "greedy",
+    use_pallas: bool = False,
+    extra_device_outputs=None,
+    fetch: bool = False,
+):
+    """Run consensus over memory-bounded micrograph chunks.
+
+    The shared chunking engine behind :func:`run_consensus_dir` and
+    the two-phase ``get_cliques`` CLI.  When one chunk covers the
+    whole workload, padding sticks to the mesh axis (the historical
+    single-batch shapes, so recorded capacity configs and compiled
+    programs stay valid); otherwise every chunk pads to the same
+    fixed shape -> one compile, many executions.  A chunk that
+    exhausts device memory is halved (to a mesh-axis multiple) and
+    retried — the memory analog of run_consensus_batch's
+    capacity-escalation ladder, catching the data-dependent
+    candidate-product blowups the static estimate cannot see.
+
+    Args:
+        extra_device_outputs: optional ``f(batch) -> pytree`` of
+            additional device computations to run per chunk (e.g. CC
+            labels) and fetch together with the result.
+        fetch: ``device_get`` the result (and extras) per chunk — ONE
+            transfer for everything, so per-micrograph consumers
+            never pay a round trip per array.
+
+    Yields:
+        ``(part, batch, result, extras, seconds)`` per chunk, where
+        ``part`` is the chunk's slice of ``loaded`` and ``seconds``
+        covers device compute (+ fetch when requested).
+    """
+    from repic_tpu.utils.tracing import annotate
+
+    k = len(loaded[0][1])
+    nb = bucket_size(max(bs.n for _, sets in loaded for bs in sets))
+    chunk = _auto_chunk(len(loaded), k, nb, n_dev)
     i = 0
     while i < len(loaded):
         single = chunk >= len(loaded)
@@ -670,7 +742,15 @@ def run_consensus_dir(
                     solver=solver,
                     use_pallas=use_pallas,
                 )
-                jax.block_until_ready(res.picked)
+                extras = (
+                    extra_device_outputs(cbatch)
+                    if extra_device_outputs is not None
+                    else None
+                )
+                if fetch:
+                    res, extras = jax.device_get((res, extras))
+                else:
+                    jax.block_until_ready(res.picked)
         except Exception as e:  # noqa: BLE001 — filtered to OOM below
             if _is_oom_error(e) and chunk > n_dev:
                 chunk = max(
@@ -682,27 +762,5 @@ def run_consensus_dir(
                 )
                 continue
             raise
-        compute_s += time.time() - t1
-        t2 = time.time()
-        counts.update(
-            write_consensus_boxes(
-                cbatch, res, out_dir, box_size,
-                num_particles=num_particles,
-            )
-        )
-        write_s += time.time() - t2
-        num_cliques += int(np.sum(np.asarray(res.num_cliques)))
+        yield part, cbatch, res, extras, time.time() - t1
         i += len(part)
-    timer.stages.append(("compute", compute_s))
-    timer.stages.append(("write", write_s))
-    timer.write_tsv(out_dir, "consensus_runtime.tsv")
-    stats.update(
-        compute_s=compute_s,
-        write_s=write_s,
-        total_s=time.time() - t0,
-        particle_counts=counts,
-        num_cliques=num_cliques,
-    )
-    if chunk < len(loaded):
-        stats["chunk"] = chunk
-    return stats
