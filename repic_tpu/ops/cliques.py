@@ -97,6 +97,8 @@ def enumerate_cliques(
     threshold: float = DEFAULT_THRESHOLD,
     max_neighbors: int = 16,
     use_pallas: bool = False,
+    clique_capacity: int | None = None,
+    anchor_chunk: int | None = None,
 ) -> CliqueSet:
     """Enumerate all k-cliques of the k-partite overlap graph.
 
@@ -111,9 +113,16 @@ def enumerate_cliques(
             (:mod:`repic_tpu.ops.iou_pallas`) instead of
             matrix + top_k — no ``(N, N)`` intermediate (interpreted
             off-TPU, compiled on TPU).
+        clique_capacity / anchor_chunk: when both are set and
+            ``N > anchor_chunk``, assembly streams anchor blocks
+            through the chunked path (bounding the
+            ``N * D**(K-1)`` candidate transient that explodes on
+            high-K ensembles) and the result is compacted to the
+            ``clique_capacity`` highest-weight rows.
 
     Returns:
-        A :class:`CliqueSet` with capacity ``N * D**(K-1)``.
+        A :class:`CliqueSet` with capacity ``N * D**(K-1)``, or
+        ``min(clique_capacity, ...)`` on the anchor-chunked path.
     """
     K, N, _ = xy.shape
     if K < 2:
@@ -155,6 +164,22 @@ def enumerate_cliques(
         nbr_idx.append(i)
     max_adjacency = jnp.max(jnp.stack(adj_counts)).astype(jnp.int32)
 
+    if (
+        clique_capacity is not None
+        and anchor_chunk is not None
+        and N > anchor_chunk
+    ):
+        # High-K ensembles explode the assembly's N x D^(K-1)
+        # candidate product even at moderate N (k=5 at D=32 is 1M
+        # tuples per anchor — terabytes over a micrograph batch);
+        # stream anchors through the same chunked assembly the
+        # bucketed path uses, bounding the transient to
+        # anchor_chunk x D^(K-1).
+        return _assemble_cliques_chunked(
+            xy, conf, mask, box_size, threshold,
+            nbr_idx, nbr_iou, max_adjacency, jnp.int32(0),
+            clique_capacity, anchor_chunk,
+        )
     return _assemble_cliques(
         xy, conf, mask, box_size, threshold,
         nbr_idx, nbr_iou, max_adjacency, jnp.int32(0),
@@ -422,22 +447,21 @@ def _assemble_cliques_chunked(
     )
     num_valid = jnp.sum(res.pop("nvalid")).astype(jnp.int32)
     # Merge the per-chunk buffers and compact once more to the final
-    # capacity (again index-ordered; escalation covers overflow).
-    merged = {
-        k2: v.reshape((nc * keep,) + v.shape[2:]) for k2, v in res.items()
-    }
-    final = _stream_compact(merged, clique_capacity)
-    return CliqueSet(
-        member_idx=final["member_idx"],
-        valid=final["valid"],
-        w=final["w"],
-        confidence=final["confidence"],
-        rep_slot=final["rep_slot"],
-        rep_xy=final["rep_xy"],
+    # capacity — by WEIGHT, preserving compact_cliques' best-effort
+    # top-weight contract on overflow for callers outside the
+    # escalation loop (per-chunk compaction stays index-ordered and
+    # cheap; this one sort covers nc * keep rows, once).  Inside the
+    # escalation contract nothing is ever dropped either way.
+    merged = CliqueSet(
         max_adjacency=max_adjacency,
         max_cell_count=max_cell_count,
         num_valid=num_valid,
+        **{
+            k2: v.reshape((nc * keep,) + v.shape[2:])
+            for k2, v in res.items()
+        },
     )
+    return compact_cliques(merged, clique_capacity)
 
 
 def _stream_compact(block: dict, keep: int) -> dict:

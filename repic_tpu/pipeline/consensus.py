@@ -79,18 +79,19 @@ def consensus_one(
     (LP relaxation + rounding, never worse than greedy).
     """
     n = xy.shape[1]
+    # Bound the per-chunk candidate transient (anchors x D^(K-1)) to
+    # ~2M tuples regardless of K and D — the K=4 stress config at
+    # D=16 would otherwise produce 16.7M-tuple blocks whose edge
+    # tensors OOM the chip when vmapped over micrographs, and the k=5
+    # batch-directory config at escalated D needs terabytes on the
+    # dense path.  The floor of 8 anchors trades the bound for
+    # progress only in the pathological D^(K-1) > 256k regime (more
+    # sequential chunks, never a >8x bound violation).
+    dprod = max_neighbors ** (xy.shape[0] - 1)
+    anchor_chunk = int(
+        min(4096, max(8, (1 << 21) // max(dprod, 1)))
+    )
     if spatial_grid is not None:
-        # Bound the per-chunk candidate transient (anchors x D^(K-1))
-        # to ~2M tuples regardless of K and D — the K=4 stress config
-        # at D=16 would otherwise produce 16.7M-tuple blocks whose
-        # edge tensors OOM the chip when vmapped over micrographs.
-        # The floor of 8 anchors trades the bound for progress only in
-        # the pathological D^(K-1) > 256k regime (more sequential
-        # chunks, never a >8x bound violation).
-        dprod = max_neighbors ** (xy.shape[0] - 1)
-        anchor_chunk = int(
-            min(4096, max(8, (1 << 21) // max(dprod, 1)))
-        )
         cs = enumerate_cliques_bucketed(
             xy,
             conf,
@@ -112,6 +113,8 @@ def consensus_one(
             threshold=threshold,
             max_neighbors=max_neighbors,
             use_pallas=use_pallas,
+            clique_capacity=clique_capacity,
+            anchor_chunk=anchor_chunk,
         )
     num_cliques = cs.num_valid
     cs = compact_cliques(cs, clique_capacity)
