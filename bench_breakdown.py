@@ -143,7 +143,7 @@ def bench_headline(platform: str) -> dict:
     run_consensus_batch(batch, 180.0, use_mesh=False)
     from repic_tpu.pipeline.consensus import last_good_config
 
-    (d, cap, cell_cap) = last_good_config(batch.xy.shape)
+    (d, cap, cell_cap) = last_good_config(batch.xy.shape)[:3]
     fn = make_batched_consensus(
         max_neighbors=d, clique_capacity=cap, mesh=None
     )
@@ -278,7 +278,7 @@ def bench_stress(platform: str, m: int = 4, n: int = 50_000, k: int = 4):
     first_s = time.time() - t0
 
     # recover the probed capacities and grid for direct timing
-    d, cap, cell_cap = last_good_config(batch.xy.shape, spatial=True)
+    d, cap, cell_cap = last_good_config(batch.xy.shape, spatial=True)[:3]
     extent = float(np.max(batch.xy)) + 180.0
     grid = grid_size(extent, 180.0)
     fn = make_batched_consensus(

@@ -63,7 +63,14 @@ class CliqueSet(NamedTuple):
     rep_xy: jax.Array       # (C, 2) float — representative coordinates
     max_adjacency: jax.Array  # () int32 — neighbor-list overflow probe
     max_cell_count: jax.Array  # () int32 — bucket overflow probe (0 = dense path)
-    num_valid: jax.Array    # () int32 — valid cliques BEFORE any compaction
+    # () int32 — valid cliques BEFORE any compaction (product paths);
+    # on the staged path, the survivor count at the accepted capacity
+    # (equal to the true count whenever max_partial fits — see
+    # enumerate_cliques Returns)
+    num_valid: jax.Array
+    # () int32 — staged-join partial-tuple overflow probe (0 on the
+    # product paths); escalation must raise clique_capacity to this
+    max_partial: jax.Array | int = 0
 
     @property
     def capacity(self) -> int:
@@ -88,6 +95,13 @@ def _per_picker_sizes(box_size, k: int, dtype) -> jax.Array:
     return jnp.broadcast_to(jnp.asarray(box_size, dtype).reshape(-1), (k,))
 
 
+# Candidate-product size above which the staged join replaces the
+# one-shot product assembly (given a clique_capacity to bound stages):
+# below this the fully-parallel product is cheap; above it the
+# product's D^(K-1) work/memory dwarfs the survivors.
+_STAGED_DPROD = 256
+
+
 def enumerate_cliques(
     xy: jax.Array,
     conf: jax.Array,
@@ -99,6 +113,7 @@ def enumerate_cliques(
     use_pallas: bool = False,
     clique_capacity: int | None = None,
     anchor_chunk: int | None = None,
+    partial_capacity: int | None = None,
 ) -> CliqueSet:
     """Enumerate all k-cliques of the k-partite overlap graph.
 
@@ -113,16 +128,25 @@ def enumerate_cliques(
             (:mod:`repic_tpu.ops.iou_pallas`) instead of
             matrix + top_k — no ``(N, N)`` intermediate (interpreted
             off-TPU, compiled on TPU).
-        clique_capacity / anchor_chunk: when both are set and
-            ``N > anchor_chunk``, assembly streams anchor blocks
-            through the chunked path (bounding the
-            ``N * D**(K-1)`` candidate transient that explodes on
-            high-K ensembles) and the result is compacted to the
-            ``clique_capacity`` highest-weight rows.
+        clique_capacity / anchor_chunk / partial_capacity: bounded
+            assembly controls.  High-K ensembles whose candidate
+            product ``D**(K-1)`` exceeds ``_STAGED_DPROD`` run the
+            staged join (per-stage work ``O(partial_capacity * D)``;
+            ``partial_capacity`` defaults to ``clique_capacity``);
+            moderate-K but ``N > anchor_chunk`` runs the
+            anchor-chunked product compacted to the
+            ``clique_capacity`` highest-weight rows; otherwise the
+            full product assembly runs.
 
     Returns:
-        A :class:`CliqueSet` with capacity ``N * D**(K-1)``, or
-        ``min(clique_capacity, ...)`` on the anchor-chunked path.
+        A :class:`CliqueSet` with capacity ``N * D**(K-1)`` (full
+        product), ``min(clique_capacity, ...)`` (anchor-chunked), or
+        ``partial_capacity`` (staged).  ``num_valid`` is the true
+        pre-compaction clique count on the product paths; on the
+        staged path it is the survivor count at the accepted
+        capacity, which equals the true count whenever
+        ``max_partial <= partial_capacity`` (the escalation
+        contract).
     """
     K, N, _ = xy.shape
     if K < 2:
@@ -164,16 +188,26 @@ def enumerate_cliques(
         nbr_idx.append(i)
     max_adjacency = jnp.max(jnp.stack(adj_counts)).astype(jnp.int32)
 
+    if clique_capacity is not None and D ** (K - 1) > _STAGED_DPROD:
+        # High-K ensembles explode the product assembly's
+        # N x D^(K-1) candidate transient even at moderate N (k=5 at
+        # D=32 is 1M tuples per anchor — terabytes over a micrograph
+        # batch) AND its compute (billions of tuples validated for a
+        # few thousand survivors); the staged join bounds both to
+        # O(partial_capacity * D) per stage.  Small products stay on
+        # the one-shot path, which is more parallel.
+        return _assemble_cliques_staged(
+            xy, conf, mask, box_size, threshold,
+            nbr_idx, nbr_iou, max_adjacency, jnp.int32(0),
+            partial_capacity or clique_capacity,
+        )
     if (
         clique_capacity is not None
         and anchor_chunk is not None
         and N > anchor_chunk
     ):
-        # High-K ensembles explode the assembly's N x D^(K-1)
-        # candidate product even at moderate N (k=5 at D=32 is 1M
-        # tuples per anchor — terabytes over a micrograph batch);
-        # stream anchors through the same chunked assembly the
-        # bucketed path uses, bounding the transient to
+        # Moderate-K but large-N: stream anchors through the chunked
+        # assembly the bucketed path uses, bounding the transient to
         # anchor_chunk x D^(K-1).
         return _assemble_cliques_chunked(
             xy, conf, mask, box_size, threshold,
@@ -198,6 +232,7 @@ def enumerate_cliques_bucketed(
     cell_capacity: int = 64,
     clique_capacity: int | None = None,
     anchor_chunk: int = 4096,
+    partial_capacity: int | None = None,
 ) -> CliqueSet:
     """Memory-bounded clique enumeration for dense micrographs.
 
@@ -251,6 +286,16 @@ def enumerate_cliques_bucketed(
         nbr_idx.append(i)
     max_adjacency = jnp.max(jnp.stack(adj_counts)).astype(jnp.int32)
 
+    if clique_capacity is not None and D ** (K - 1) > _STAGED_DPROD:
+        # High-K blowup is worst exactly where the bucketed path
+        # runs (dense fields): route the same staged join the dense
+        # path uses instead of validating anchor_chunk x D^(K-1)
+        # product tuples per chunk.
+        return _assemble_cliques_staged(
+            xy, conf, mask, box_size, threshold,
+            nbr_idx, nbr_iou, max_adjacency, max_cell_count,
+            partial_capacity or clique_capacity,
+        )
     if clique_capacity is not None and N > anchor_chunk:
         return _assemble_cliques_chunked(
             xy, conf, mask, box_size, threshold,
@@ -508,4 +553,139 @@ def compact_cliques(cs: CliqueSet, capacity: int) -> CliqueSet:
         max_adjacency=cs.max_adjacency,
         max_cell_count=cs.max_cell_count,
         num_valid=cs.num_valid,
+        max_partial=cs.max_partial,
+    )
+
+
+def _assemble_cliques_staged(
+    xy, conf, mask, box_size, threshold,
+    nbr_idx, nbr_iou, max_adjacency, max_cell_count,
+    clique_capacity,
+) -> CliqueSet:
+    """Staged k-partite join with inter-stage compaction.
+
+    The product paths materialize every ``(anchor, n_1, ..., n_{K-1})``
+    combination — ``D**(K-1)`` tuples per anchor — then validate.  At
+    K=5 with an escalated D that is billions of tuples per micrograph,
+    of which a few thousand survive.  Here partial cliques are
+    extended one picker at a time: after adding picker ``s``'s
+    candidates, cross edges against ALL previous members are validated
+    elementwise and the survivors compacted to ``clique_capacity``
+    slots before the next stage, so per-stage work is
+    ``O(clique_capacity * D)`` instead of ``O(N * D**(K-1))``.
+
+    Exactness: a valid k-clique's every prefix is itself pairwise
+    valid, so it survives every stage *provided no compaction
+    overflows*.  The max partial-tuple count across stages is reported
+    as ``max_partial``; the caller's escalation loop re-runs with
+    ``clique_capacity >= max_partial``, the same contract that makes
+    the product paths complete (run_consensus_batch).  Enumeration
+    order differs from the product paths but the clique SET, weights,
+    and representatives are identical (tests/test_cliques.py).
+    """
+    K, N, _ = xy.shape
+    D = nbr_idx[0].shape[1]
+    dtype = xy.dtype
+    cap = clique_capacity
+    xs, ys = xy[..., 0], xy[..., 1]
+    sizes = _per_picker_sizes(box_size, K, dtype)
+
+    # Stage 1: (anchor, n_1) pairs straight from the neighbor lists.
+    anchor = jnp.repeat(jnp.arange(N, dtype=jnp.int32), D)
+    m1 = nbr_idx[0].reshape(-1)
+    in_range = m1 < N
+    m1s = jnp.where(in_range, m1, 0).astype(jnp.int32)
+    valid = (
+        mask[0][anchor]
+        & in_range
+        & jnp.where(in_range, mask[1][m1s], False)
+        & (nbr_iou[0].reshape(-1) > threshold)
+    )
+    members = jnp.stack([anchor, m1s], axis=1)  # (N*D, 2)
+    max_partial = jnp.sum(valid).astype(jnp.int32)
+    part = _stream_compact({"members": members, "valid": valid}, cap)
+    members, valid = part["members"], part["valid"]
+
+    # Stages 2..K-1: extend by picker s's candidates, validate cross
+    # edges against every previous member, compact.
+    for s in range(2, K):
+        anchor = members[:, 0]
+        cand = nbr_idx[s - 1][anchor]          # (cap, D)
+        ciou = nbr_iou[s - 1][anchor]          # (cap, D)
+        ext = jnp.repeat(members, D, axis=0)   # (cap*D, s)
+        m_new = cand.reshape(-1)
+        in_range = m_new < N
+        m_new = jnp.where(in_range, m_new, 0).astype(jnp.int32)
+        v = (
+            jnp.repeat(valid, D)
+            & (ciou.reshape(-1) > threshold)
+            & in_range
+            & jnp.where(in_range, mask[s][m_new], False)
+        )
+        for t in range(1, s):
+            e = pair_iou_xy(
+                xs[t][ext[:, t]], ys[t][ext[:, t]],
+                xs[s][m_new], ys[s][m_new],
+                sizes[t], sizes[s],
+            )
+            v = v & (e > threshold)
+        members = jnp.concatenate([ext, m_new[:, None]], axis=1)
+        max_partial = jnp.maximum(
+            max_partial, jnp.sum(v).astype(jnp.int32)
+        )
+        part = _stream_compact(
+            {"members": members, "valid": v}, cap
+        )
+        members, valid = part["members"], part["valid"]
+
+    # Final statistics over the (cap, K) survivors — same formulas as
+    # _assemble_block (edges in _edge_pairs order, median confidence,
+    # weighted-degree representative).
+    edge_vals = []
+    for p, q in _edge_pairs(K):
+        e = pair_iou_xy(
+            xs[p][members[:, p]], ys[p][members[:, p]],
+            xs[q][members[:, q]], ys[q][members[:, q]],
+            sizes[p], sizes[q],
+        )
+        edge_vals.append(jnp.where(valid, e, 0.0))
+    edges = jnp.stack(edge_vals)               # (E, cap)
+    valid = valid & jnp.all(edges > threshold, axis=0)
+
+    confs = jnp.stack(
+        [conf[p][members[:, p]] for p in range(K)]
+    )                                          # (K, cap)
+    confidence = jnp.median(confs, axis=0)
+    edge_med = jnp.median(edges, axis=0)
+    w = jnp.where(valid, confidence * edge_med, 0.0).astype(dtype)
+    confidence = jnp.where(valid, confidence, 0.0).astype(dtype)
+
+    degs = []
+    for k_slot in range(K):
+        incident = [
+            edges[e]
+            for e, (p, q) in enumerate(_edge_pairs(K))
+            if p == k_slot or q == k_slot
+        ]
+        degs.append(sum(incident))
+    rep_slot = jnp.argmax(jnp.stack(degs), axis=0).astype(jnp.int32)
+    rep_particle = jnp.take_along_axis(
+        members, rep_slot[:, None], axis=1
+    ).squeeze(1)
+    rep_xy = jnp.stack(
+        [xs[rep_slot, rep_particle], ys[rep_slot, rep_particle]],
+        axis=-1,
+    )
+
+    return CliqueSet(
+        member_idx=members.astype(jnp.int32),
+        valid=valid,
+        w=w,
+        confidence=confidence,
+        rep_slot=rep_slot,
+        rep_xy=rep_xy,
+        max_adjacency=max_adjacency,
+        max_cell_count=max_cell_count,
+        num_valid=jnp.sum(valid).astype(jnp.int32),
+        max_partial=max_partial,
     )

@@ -54,6 +54,8 @@ class ConsensusResult(NamedTuple):
     num_cliques: jax.Array  # () int32 — valid cliques before compaction
     max_adjacency: jax.Array  # () int32 — neighbor-list overflow probe
     max_cell_count: jax.Array  # () int32 — bucket overflow probe (0 = dense)
+    # () int32 — staged-join partial overflow probe (0 on product paths)
+    max_partial: jax.Array | int = 0
 
 
 def consensus_one(
@@ -69,6 +71,7 @@ def consensus_one(
     cell_capacity: int = 64,
     solver: str = "greedy",
     use_pallas: bool = False,
+    partial_capacity: int | None = None,
 ) -> ConsensusResult:
     """Full consensus for one micrograph (jit/vmap-friendly).
 
@@ -103,6 +106,7 @@ def consensus_one(
             cell_capacity=cell_capacity,
             clique_capacity=clique_capacity,
             anchor_chunk=anchor_chunk,
+            partial_capacity=partial_capacity,
         )
     else:
         cs = enumerate_cliques(
@@ -115,6 +119,7 @@ def consensus_one(
             use_pallas=use_pallas,
             clique_capacity=clique_capacity,
             anchor_chunk=anchor_chunk,
+            partial_capacity=partial_capacity,
         )
     num_cliques = cs.num_valid
     cs = compact_cliques(cs, clique_capacity)
@@ -134,6 +139,7 @@ def consensus_one(
         num_cliques=num_cliques,
         max_adjacency=cs.max_adjacency,
         max_cell_count=cs.max_cell_count,
+        max_partial=jnp.asarray(cs.max_partial, jnp.int32),
     )
 
 
@@ -147,6 +153,7 @@ def make_batched_consensus(
     cell_capacity: int = 64,
     solver: str = "greedy",
     use_pallas: bool = False,
+    partial_capacity: int | None = None,
 ):
     """Build the jitted batched consensus fn, sharded over micrographs.
 
@@ -160,6 +167,7 @@ def make_batched_consensus(
     return _make_batched_consensus(
         threshold, max_neighbors, clique_capacity, mesh,
         spatial_grid, cell_capacity, solver, use_pallas,
+        partial_capacity,
     )
 
 
@@ -167,6 +175,7 @@ def make_batched_consensus(
 def _make_batched_consensus(
     threshold, max_neighbors, clique_capacity, mesh,
     spatial_grid, cell_capacity, solver="greedy", use_pallas=False,
+    partial_capacity=None,
 ):
     single = partial(
         consensus_one,
@@ -177,6 +186,7 @@ def _make_batched_consensus(
         cell_capacity=cell_capacity,
         solver=solver,
         use_pallas=use_pallas,
+        partial_capacity=partial_capacity,
     )
     batched = jax.vmap(single, in_axes=(0, 0, 0, None))
     if mesh is None:
@@ -295,8 +305,9 @@ _LAST_GOOD_CONFIG: dict = {}
 
 def last_good_config(xy_shape, spatial: bool | None = None):
     """The recorded sufficient capacities ``(max_neighbors,
-    clique_capacity, cell_capacity)`` for a batch of this shape, from
-    the most recent :func:`run_consensus_batch` escalation.
+    clique_capacity, cell_capacity, partial_capacity)`` for a batch
+    of this shape, from the most recent :func:`run_consensus_batch`
+    escalation.
 
     ``spatial`` filters on the bucketed-path flag when not ``None``.
     Raises ``RuntimeError`` (instead of a bare ``StopIteration`` from
@@ -321,14 +332,15 @@ def _next_pow2(x: int) -> int:
 
 
 @jax.jit
-def _probe_reduce(max_adjacency, num_cliques, max_cell_count):
-    """Reduce the three overflow probes to one (3,) device array so
+def _probe_reduce(max_adjacency, num_cliques, max_cell_count, max_partial):
+    """Reduce the four overflow probes to one (4,) device array so
     the escalation check costs a single host transfer."""
     return jnp.stack(
         [
             jnp.max(max_adjacency),
             jnp.max(num_cliques),
             jnp.max(max_cell_count),
+            jnp.max(max_partial),
         ]
     ).astype(jnp.int32)
 
@@ -355,6 +367,7 @@ def run_consensus_batch(
     particles per picker.
     """
     cap = clique_capacity or max(4 * batch.capacity, 1024)
+    pcap = cap  # staged-join partial capacity, escalated separately
     d = max_neighbors
     mesh = consensus_mesh() if use_mesh else None
     if spatial is None:
@@ -416,7 +429,7 @@ def run_consensus_batch(
         # 16x the candidate work — plus one extra compile — on every
         # repeat batch; the escalation loop below still catches any
         # data drift upward.
-        d, cap, cell_cap = known
+        d, cap, cell_cap, pcap = known
     while True:
         fn = make_batched_consensus(
             threshold=threshold,
@@ -427,6 +440,7 @@ def run_consensus_batch(
             cell_capacity=cell_cap,
             solver=solver,
             use_pallas=use_pallas,
+            partial_capacity=pcap,
         )
         xy, conf, mask = batch.xy, batch.conf, batch.mask
         if mesh is not None:
@@ -437,11 +451,11 @@ def run_consensus_batch(
         # The three probes are reduced on device and fetched in ONE
         # transfer: per-scalar fetches each pay a full host<->device
         # round trip (expensive over a tunneled TPU).
-        max_adj, n_cliques, max_cell = (
+        max_adj, n_cliques, max_cell, max_part = (
             int(v) for v in np.asarray(
                 _probe_reduce(
                     res.max_adjacency, res.num_cliques,
-                    res.max_cell_count,
+                    res.max_cell_count, res.max_partial,
                 )
             )
         )
@@ -456,9 +470,15 @@ def run_consensus_batch(
         if n_cliques > cap:
             cap = _next_pow2(n_cliques)
             retry = True
+        if max_part > pcap:
+            # partial tuples live in their own (pcap, K) buffers, so
+            # escalating them does not inflate the final clique
+            # buffers / solver pack the way escalating `cap` would
+            pcap = _next_pow2(max_part)
+            retry = True
         if retry:
             continue
-        _LAST_GOOD_CONFIG[cfg_key] = (d, cap, cell_cap)
+        _LAST_GOOD_CONFIG[cfg_key] = (d, cap, cell_cap, pcap)
         return res
 
 
