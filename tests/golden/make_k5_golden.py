@@ -1,0 +1,181 @@
+#!/usr/bin/env python3
+"""Generate the k=5 clique golden by EXECUTING the reference.
+
+Synthesizes a deterministic 5-picker, 2-micrograph BOX fixture
+(committed under tests/fixtures/mini_k5/), runs the reference's
+``get_cliques`` (networkx Bron-Kerbosch path,
+reference: repic/commands/get_cliques.py) on it in a subprocess with
+``--multi_out`` so every clique's full membership is recorded, and
+writes ``tests/golden/ref_cliques_k5.json`` mapping each clique to
+(picker_slot, particle_index) members plus the reference's exact
+weight and confidence.
+
+The fixture is clustered densely enough (5 jittered points per picker
+per cluster) that the measured adjacency pushes the neighbor capacity
+D to 8, so D**(K-1) = 4096 exceeds the staged-join dispatch threshold
+— the golden therefore gates the STAGED path end-to-end, not the
+product assembly (tests/test_k5_golden.py).
+
+Run from the repo root with the reference mounted at /root/reference:
+    python tests/golden/make_k5_golden.py
+"""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+FIXTURE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "fixtures", "mini_k5",
+)
+GOLDEN = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "ref_cliques_k5.json"
+)
+REFERENCE = "/root/reference"
+
+BOX = 48
+PICKERS = [f"picker{i}" for i in range(5)]
+MICROGRAPHS = ["mic_a", "mic_b"]
+
+
+def synth_fixture():
+    """Deterministic clustered 5-picker BOX set (written once)."""
+    rng = np.random.default_rng(20260730)
+    os.makedirs(FIXTURE, exist_ok=True)
+    for p in PICKERS:
+        os.makedirs(os.path.join(FIXTURE, p), exist_ok=True)
+    for mic in MICROGRAPHS:
+        # 6 well-separated clusters; 5 tightly-jittered points per
+        # picker per cluster -> dense cross-picker adjacency (the
+        # staged-join regime) but no cross-cluster edges
+        centers = rng.uniform(100, 900, size=(6, 2))
+        while True:
+            d = np.linalg.norm(
+                centers[:, None] - centers[None, :], axis=-1
+            )
+            np.fill_diagonal(d, 1e9)
+            if d.min() > 3 * BOX:
+                break
+            centers = rng.uniform(100, 900, size=(6, 2))
+        for p in PICKERS:
+            rows = []
+            for cx, cy in centers:
+                for _ in range(5):
+                    x = cx + rng.uniform(-5, 5)
+                    y = cy + rng.uniform(-5, 5)
+                    conf = rng.uniform(0.2, 1.0)
+                    rows.append((x, y, conf))
+            with open(
+                os.path.join(FIXTURE, p, f"{mic}.box"), "wt"
+            ) as f:
+                for x, y, c in rows:
+                    f.write(f"{x:.2f}\t{y:.2f}\t{BOX}\t{BOX}\t{c:.6f}\n")
+
+
+def run_reference(out_dir):
+    code = (
+        "import sys, argparse\n"
+        f"sys.path.insert(0, {REFERENCE!r})\n"
+        "from repic.commands import get_cliques\n"
+        "p = argparse.ArgumentParser()\n"
+        "get_cliques.add_arguments(p)\n"
+        f"a = p.parse_args([{FIXTURE!r}, {out_dir!r}, '{BOX}',"
+        " '--multi_out'])\n"
+        "get_cliques.main(a)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True
+    )
+    if proc.returncode != 0:
+        sys.exit(
+            f"reference get_cliques failed ({proc.returncode}):\n"
+            + proc.stderr[-2000:]
+        )
+
+
+def load_fixture_coords():
+    coords = {}
+    for mic in MICROGRAPHS:
+        per = []
+        for p in PICKERS:
+            rows = []
+            with open(os.path.join(FIXTURE, p, f"{mic}.box")) as f:
+                for line in f:
+                    t = line.split()
+                    rows.append((float(t[0]), float(t[1])))
+            per.append(rows)
+        coords[mic] = per
+    return coords
+
+
+def main():
+    if not os.path.isdir(REFERENCE):
+        sys.exit("reference not mounted; cannot regenerate golden")
+    if not os.path.isdir(FIXTURE):
+        synth_fixture()
+    coords = load_fixture_coords()
+
+    out_dir = tempfile.mkdtemp(prefix="ref_k5_")
+    run_reference(out_dir)
+
+    golden = {"box_size": BOX, "pickers": PICKERS, "micrographs": {}}
+    for mic in MICROGRAPHS:
+        with open(
+            os.path.join(out_dir, f"{mic}_consensus_coords.pickle"), "rb"
+        ) as f:
+            cliques = pickle.load(f)
+        with open(
+            os.path.join(out_dir, f"{mic}_weight_vector.pickle"), "rb"
+        ) as f:
+            w = pickle.load(f)
+        with open(
+            os.path.join(out_dir, f"{mic}_consensus_confidences.pickle"),
+            "rb",
+        ) as f:
+            conf = pickle.load(f)
+        header, body = cliques[0], cliques[1:]
+        assert header == PICKERS, header
+        # with --multi_out and no --get_cc the reference appends its
+        # "unmatched singleton" rows (every particle, a documented
+        # reference defect) after the true cliques — the true cliques
+        # are exactly the first len(w) rows
+        body = body[: len(w)]
+        # the reference's --multi_out slot ordering is corrupted (its
+        # node `name` attributes are overwritten with wrong picker
+        # labels — see repic_tpu/commands/get_cliques.py module
+        # docstring), so recover each node's TRUE picker by exact
+        # coordinate lookup (float parse of the same BOX text)
+        lookup = {}
+        for slot, rows in enumerate(coords[mic]):
+            for idx, xy in enumerate(rows):
+                # a cross-picker coordinate collision would silently
+                # record the wrong slot — fail loudly instead
+                assert xy not in lookup, f"coordinate collision: {xy}"
+                lookup[xy] = (slot, idx)
+        members = []
+        for clique in body:
+            row = sorted(
+                lookup[(float(x), float(y))] for x, y, _nid in clique
+            )
+            members.append([list(t) for t in row])
+        golden["micrographs"][mic] = {
+            "members": members,
+            "w": [float(v) for v in w],
+            "conf": [float(v) for v in conf],
+        }
+    with open(GOLDEN, "wt") as f:
+        json.dump(golden, f)
+    n = sum(
+        len(v["members"]) for v in golden["micrographs"].values()
+    )
+    print(f"golden written: {n} cliques over {len(MICROGRAPHS)} micrographs")
+
+
+if __name__ == "__main__":
+    main()
