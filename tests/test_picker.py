@@ -39,7 +39,11 @@ def test_bin2d_matches_numpy_oracle(rng):
     for i in range(5):
         for j in range(7):
             want[i, j] = img[3 * i : 3 * i + 3, 3 * j : 3 * j + 3].mean()
-    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # atol matters: a 3x3 mean of standard normals can land arbitrarily
+    # close to zero, where any pure-rtol comparison of two differently
+    # associated float32 sums flakes (seen once in a full-suite run
+    # where the shared rng stream happened to produce such a cell)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
 
 
 def test_gaussian_sigma01_is_identity(rng):
