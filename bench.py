@@ -34,6 +34,7 @@ idle machine — a 3.5x measurement artifact, not a code regression).
 the CPU number immediately (fast-fallback escape hatch).
 """
 
+import fcntl
 import json
 import os
 import shutil
@@ -76,6 +77,36 @@ LAST_TPU_PATH = os.path.join(
 LEGACY_TPU_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_LAST.json"
 )
+# Advisory single-chip lock shared with scripts/tpu_runbook.sh: only
+# one process may open a TPU client over the tunnel at a time (even a
+# probe perturbs an in-flight measurement).  bench.py holds it across
+# its own probe+measurement; while the watcher holds it, bench.py
+# treats the chip as busy and keeps waiting instead of contending.
+CHIP_LOCK_PATH = os.environ.get(
+    "REPIC_CHIP_LOCK", "/tmp/repic_tpu_chip.lock"
+)
+
+
+def _try_chip_lock():
+    """Attempt the advisory chip lock.
+
+    Returns ``(handle, None)`` on success, ``(None, None)`` when
+    another process holds the lock, and ``(None, reason)`` when the
+    lock file itself can't be opened (config error — distinct from
+    "chip busy" so a bad REPIC_CHIP_LOCK path isn't misdiagnosed as a
+    15-minute busy wait).  The lock lives while the handle is open;
+    callers release it with ``.close()``.
+    """
+    try:
+        f = open(CHIP_LOCK_PATH, "w")
+    except OSError as e:
+        return None, f"chip lock path unusable: {e}"
+    try:
+        fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        f.close()
+        return None, None
+    return f, None
 
 
 def _synthesize(dst, n_micro=12, n_per=700, k=3, seed=0):
@@ -273,32 +304,67 @@ def main():
     last_err = ""
     deadline = time.time() + TPU_WAIT_S
     attempt = 0
-    while time.time() < deadline:
-        platform = _probe_default_platform()
-        if platform == "cpu" and cpu_ok:
-            # No accelerator on this machine: the up-front CPU run IS
-            # the measurement — don't run it a second time.
-            print("default platform is cpu; reusing up-front run",
-                  file=sys.stderr, flush=True)
-            break
-        if platform is None:
-            last_err = "backend probe failed or hung"
-            remaining = deadline - time.time()
-            if remaining <= PROBE_INTERVAL_S:
-                break
-            print(
-                f"probe unhealthy; retrying in {PROBE_INTERVAL_S}s "
-                f"({int(remaining)}s left in TPU window)",
-                file=sys.stderr,
-                flush=True,
-            )
-            time.sleep(PROBE_INTERVAL_S)
-            continue
-        attempt += 1
-        ok, line, err = _run_child(
-            force_cpu=False, timeout_s=CHILD_TIMEOUT_S
+
+    def _wait_for_retry(reason: str) -> bool:
+        """Sleep out one probe interval; False when the window is spent."""
+        remaining = deadline - time.time()
+        if remaining <= PROBE_INTERVAL_S:
+            return False
+        print(
+            f"{reason}; retrying in {PROBE_INTERVAL_S}s "
+            f"({int(remaining)}s left in TPU window)",
+            file=sys.stderr,
+            flush=True,
         )
+        time.sleep(PROBE_INTERVAL_S)
+        return True
+
+    while time.time() < deadline:
+        # Hold the shared single-chip lock across probe + measurement
+        # (and nothing else — never across a retry sleep) so bench.py
+        # and the tpu_runbook watcher never open two TPU clients over
+        # the one tunnel at the same time.
+        chip, lock_err = _try_chip_lock()
+        if chip is None:
+            if lock_err is not None:
+                last_err = lock_err  # config error, not "chip busy"
+            elif not last_err:
+                # Don't overwrite a real measurement-failure reason
+                # with the generic busy string.
+                last_err = (
+                    "chip lock held (another TPU measurement in flight)"
+                )
+            if not _wait_for_retry("chip busy"):
+                break
+            continue
+        probe_unhealthy = False
+        ok = False
+        try:
+            platform = _probe_default_platform()
+            if platform == "cpu" and cpu_ok:
+                # No accelerator on this machine: the up-front CPU run
+                # IS the measurement — don't run it a second time.
+                print("default platform is cpu; reusing up-front run",
+                      file=sys.stderr, flush=True)
+                break
+            if platform is None:
+                probe_unhealthy = True
+            else:
+                attempt += 1
+                ok, line, err = _run_child(
+                    force_cpu=False, timeout_s=CHILD_TIMEOUT_S
+                )
+        finally:
+            chip.close()
+        if probe_unhealthy:
+            last_err = "backend probe failed or hung"
+            if not _wait_for_retry("probe unhealthy"):
+                break
+            continue
         if ok:
+            # (_record_tpu_success itself writes the sidecar only for
+            # platform=="tpu" lines, so a CPU-fallback measurement on
+            # this path can't pollute the TPU evidence.)
             _record_tpu_success(line)
             if cpu_ok:
                 # Ship both numbers: TPU headline + same-session CPU.

@@ -1,0 +1,173 @@
+#!/usr/bin/env bash
+# TPU-window catcher: probe the (frequently wedged) axon TPU tunnel on a
+# cadence and, the moment a probe answers, run the full on-TPU
+# measurement runbook and write JSON artifacts.
+#
+# Why this exists: the single TPU chip behind the tunnel wedged for the
+# entirety of rounds 3 and 4 (docs/tpu_probe_r4.log: 213 hung probes over
+# 11.4 h) — `jax.devices()` hangs indefinitely rather than erroring, so
+# every TPU number in docs/tpu.md is gated on catching a healthy window.
+# Round-4 verdict item 1: the catcher must be committed infrastructure,
+# not a session-memory shell loop.
+#
+# Usage:  nohup scripts/tpu_runbook.sh [round_tag] &
+#   round_tag defaults to r5; artifacts land in the repo root as
+#   BENCH_TPU_<tag>.json, PALLAS_TPU_<tag>.jsonl, BREAKDOWN_TPU_<tag>.jsonl
+#   and the probe/run log appends to docs/tpu_probe_<tag>.log.
+#
+# Contract:
+#   * Probes in a short-timeout subprocess (the only safe way — a wedged
+#     tunnel hangs device init forever, it does not error).
+#   * Exactly one process may hold the chip: a flock on /tmp guards the
+#     whole measurement sequence, and the probe itself is skipped while
+#     any sibling holds the lock.
+#   * Each runbook step is independently timed out; a step that hangs
+#     (tunnel re-wedged mid-run) is logged and the watcher returns to
+#     probing, re-running only the steps that have not yet produced an
+#     artifact.
+#   * On full success the watcher refreshes the .bench_tpu_last.json
+#     sidecar (same schema bench.py maintains) and exits 0.
+
+set -u
+cd "$(dirname "$0")/.." || exit 1
+
+TAG="${1:-r5}"
+LOG="docs/tpu_probe_${TAG}.log"
+LOCK="/tmp/repic_tpu_chip.lock"
+PROBE_TIMEOUT="${TPU_PROBE_TIMEOUT:-75}"
+PROBE_INTERVAL="${TPU_PROBE_INTERVAL:-120}"
+PY="${PYTHON:-python}"
+
+BENCH_OUT="BENCH_TPU_${TAG}.json"
+PALLAS_OUT="PALLAS_TPU_${TAG}.jsonl"
+# One artifact per breakdown workload: a window that closes after the
+# stress row still banks headline+stress instead of discarding all
+# three (the whole point of a catcher for minutes-long windows).
+BD_HEADLINE_OUT="BREAKDOWN_TPU_${TAG}_headline.jsonl"
+BD_STRESS_OUT="BREAKDOWN_TPU_${TAG}_stress.jsonl"
+BD_1024_OUT="BREAKDOWN_TPU_${TAG}_batch1024.jsonl"
+
+mkdir -p docs
+say() { echo "$(date -u '+%Y-%m-%d %H:%M:%S UTC') $*" >>"$LOG"; }
+
+probe() {
+    # Healthy iff the default backend initializes within the timeout
+    # AND is the TPU (a cpu answer means the tunnel is absent, not
+    # merely wedged — nothing to wait for in that case either way).
+    # -k: a wedged device init can sit in an uninterruptible tunnel
+    # read and ignore SIGTERM; escalate to SIGKILL so hung probe
+    # children don't accumulate over a multi-hour wedge.
+    local out
+    out=$(timeout -k 10 "$PROBE_TIMEOUT" "$PY" -c \
+        'import jax; print(jax.devices()[0].platform)' 2>/dev/null </dev/null \
+        | tail -n 1)
+    [ "$out" = "tpu" ]
+}
+
+# True iff the artifact holds an actually-on-TPU measurement.
+captured() { [ -s "$1" ] && grep -q '"platform": *"tpu"' "$1"; }
+
+all_captured() {
+    captured "$BENCH_OUT" && captured "$PALLAS_OUT" \
+        && captured "$BD_HEADLINE_OUT" && captured "$BD_STRESS_OUT" \
+        && captured "$BD_1024_OUT"
+}
+
+# Run one runbook step under a timeout, writing stdout to an artifact.
+# Skips the step if the artifact was already captured on-TPU (resume
+# after a mid-sequence wedge).  Returns non-zero if the step
+# failed/hung so the caller can resume probing.
+step() {
+    local name="$1" timeout_s="$2" out="$3"; shift 3
+    if captured "$out"; then
+        say "step $name: artifact $out already captured, skipping"
+        return 0
+    fi
+    say "step $name: starting (timeout ${timeout_s}s): $*"
+    if timeout -k 10 "$timeout_s" "$@" >"$out.tmp" 2>>"$LOG" </dev/null; then
+        # Exit 0 is not enough: if the tunnel dropped between probe and
+        # step, JAX silently falls back to CPU and the step "succeeds"
+        # with CPU numbers — refuse to file those under a TPU artifact.
+        if captured "$out.tmp"; then
+            mv "$out.tmp" "$out"
+            rm -f "$out.partial"
+            say "step $name: OK -> $out"
+            return 0
+        fi
+        say "step $name: ran but not on TPU (backend fell back); discarding"
+        mv "$out.tmp" "$out.partial"
+        return 1
+    fi
+    local rc=$?
+    say "step $name: FAILED rc=$rc (124 = hung/timed out; tunnel likely re-wedged)"
+    [ -s "$out.tmp" ] && mv "$out.tmp" "$out.partial"
+    return 1
+}
+
+runbook() {
+    # bench.py --child measures directly on the default (TPU) platform —
+    # fastest path to the headline number while the window is open; the
+    # full bench.py CPU-first protocol is for driver runs, not chip
+    # windows that may close in minutes.
+    step headline 600 "$BENCH_OUT" "$PY" bench.py --child || return 1
+    step pallas 1200 "$PALLAS_OUT" "$PY" bench_pallas.py || return 1
+    step bd_headline 900 "$BD_HEADLINE_OUT" "$PY" bench_breakdown.py \
+        --workloads headline || return 1
+    step bd_stress 1200 "$BD_STRESS_OUT" "$PY" bench_breakdown.py \
+        --workloads stress || return 1
+    step bd_batch1024 2400 "$BD_1024_OUT" "$PY" bench_breakdown.py \
+        --workloads batch1024 || return 1
+    # Refresh the last-healthy-TPU sidecar from the fresh headline so a
+    # later wedged bench.py run degrades to this session's number.
+    # Reuses bench.py's writer (schema + error handling live there).
+    "$PY" -c 'import sys, bench
+lines = [l for l in open(sys.argv[1]) if l.strip().startswith("{")]
+if lines: bench._record_tpu_success(lines[-1])' "$BENCH_OUT" 2>>"$LOG"
+    return 0
+}
+
+# Single-instance guard: at most one watcher per tag, for the
+# watcher's whole lifetime (relaunches are idempotent instead of
+# multiplying probe traffic and interleaving probe counters in the
+# shared log — which round 4's multi-start log actually suffered).
+exec 8>"/tmp/repic_tpu_runbook_${TAG}.lock"
+if ! flock -n 8; then
+    echo "tpu_runbook: another watcher for tag $TAG is already running" >&2
+    exit 1
+fi
+
+say "tpu_runbook start (tag=$TAG pid=$$ probe_timeout=${PROBE_TIMEOUT}s interval=${PROBE_INTERVAL}s)"
+# The chip-lock fd stays open for the life of the watcher; flock/funlock
+# on it per cycle.  (An fd opened on the flock *command* itself would be
+# closed — and the lock dropped — the moment that command returned.)
+exec 9>"$LOCK"
+n=0
+while :; do
+    n=$((n + 1))
+    # A relaunched watcher whose artifacts are all already captured has
+    # nothing to do — exit before touching the tunnel at all.
+    if all_captured; then
+        say "all artifacts already captured — exiting"
+        exit 0
+    fi
+    # Take the chip lock BEFORE probing: even the probe opens a TPU
+    # client over the tunnel, which would perturb a sibling's
+    # in-flight measurement.
+    if ! flock -n 9; then
+        say "probe $n skipped: chip lock held by another process"
+        sleep "$PROBE_INTERVAL"
+        continue
+    fi
+    if probe; then
+        say "probe $n HEALTHY — running runbook (lock held)"
+        if runbook; then
+            say "runbook COMPLETE: $BENCH_OUT $PALLAS_OUT $BD_HEADLINE_OUT $BD_STRESS_OUT $BD_1024_OUT"
+            exit 0
+        fi
+        say "runbook incomplete — resuming probe loop"
+    else
+        say "probe $n unhealthy"
+    fi
+    flock -u 9
+    sleep "$PROBE_INTERVAL"
+done
