@@ -303,20 +303,30 @@ def _make_spatial_probe(grid: int, cell_capacity: int, threshold: float):
 _LAST_GOOD_CONFIG: dict = {}
 
 
-def last_good_config(xy_shape, spatial: bool | None = None):
+def last_good_config(
+    xy_shape,
+    spatial: bool | None = None,
+    sizes=None,
+    threshold=None,
+):
     """The recorded sufficient capacities ``(max_neighbors,
     clique_capacity, cell_capacity, partial_capacity)`` for a batch
     of this shape, from the most recent :func:`run_consensus_batch`
     escalation.
 
-    ``spatial`` filters on the bucketed-path flag when not ``None``.
-    Raises ``RuntimeError`` (instead of a bare ``StopIteration`` from
-    callers poking the private dict) when no run has recorded a
-    config for the shape yet.
+    ``spatial``, ``sizes`` (the flattened box-size tuple) and
+    ``threshold`` each filter on the matching component of the cache
+    key when not ``None`` — with several workloads recorded for the
+    same batch shape, pass them to pick the right one.  Raises
+    ``RuntimeError`` (instead of a bare ``StopIteration`` from callers
+    poking the private dict) when no matching config is recorded.
     """
     for key, v in _LAST_GOOD_CONFIG.items():
-        if key[0] == xy_shape and (
-            spatial is None or key[3] == spatial
+        if (
+            key[0] == xy_shape
+            and (sizes is None or key[1] == tuple(sizes))
+            and (threshold is None or key[2] == threshold)
+            and (spatial is None or key[3] == spatial)
         ):
             return v
     raise RuntimeError(
@@ -652,10 +662,6 @@ def run_consensus_dir(
 
     timer.stages.append(("load", time.time() - t0))
     n_dev = len(jax.devices()) if use_mesh else 1
-    k = len(pickers)
-    nb = bucket_size(
-        max(bs.n for _, sets in loaded for bs in sets)
-    )
     compute_s = 0.0
     write_s = 0.0
     counts: dict = {}
