@@ -26,6 +26,18 @@ def add_arguments(parser):
         "--num_particles", type=int, help="top-N particle cutoff"
     )
     parser.add_argument(
+        "--multi_out",
+        action="store_true",
+        help="write per-picker TSVs (clique members sorted by picker "
+        "name) instead of consensus BOX files — the reference "
+        "get_cliques/run_ilp multi-out surface on the fused path",
+    )
+    parser.add_argument(
+        "--get_cc",
+        action="store_true",
+        help="keep only cliques in the largest connected component",
+    )
+    parser.add_argument(
         "--threshold", type=float, default=0.3, help="IoU edge threshold"
     )
     parser.add_argument(
@@ -82,6 +94,8 @@ def main(args):
             spatial=spatial,
             solver=args.solver,
             use_pallas=args.pallas,
+            multi_out=args.multi_out,
+            get_cc=args.get_cc,
         )
     print(json.dumps(stats, default=str, indent=2))
 

@@ -39,16 +39,27 @@ def connected_component_labels(
     graph nodes (the reference adds nodes edge-wise,
     get_cliques.py:30-37); others get ``node_mask`` False.
 
+    ``box_size`` may be a scalar or one size per picker (mixed-size
+    ensembles) — per-pair edges then use the same per-picker sizes the
+    clique enumeration uses, so the CC filter judges the same graph
+    the cliques came from.
+
     Returns:
         labels: ``(K, N)`` int32 — component label (min global vertex
             id in the component); undefined where ``node_mask`` False.
         node_mask: ``(K, N)`` bool.
     """
     K, N, _ = xy.shape
+    sizes = jnp.asarray(box_size, jnp.float32)
+    per_picker = sizes.ndim > 0
     adj = {}
     for p, q in itertools.combinations(range(K), 2):
         a = (
-            pairwise_iou_matrix(xy[p], mask[p], xy[q], mask[q], box_size)
+            pairwise_iou_matrix(
+                xy[p], mask[p], xy[q], mask[q],
+                sizes[p] if per_picker else sizes,
+                sizes[q] if per_picker else None,
+            )
             > threshold
         )
         adj[(p, q)] = a
@@ -111,9 +122,17 @@ def component_stats(labels, node_mask):
 
 
 def largest_component_label(labels, node_mask):
-    """Label of the largest CC (ties: smallest label, deterministic)."""
+    """Label of the largest CC (ties: smallest label, deterministic).
+
+    Returns ``-1`` — a value no node ever carries — when the graph has
+    no nodes at all (no above-threshold edge on the micrograph), so
+    callers' ``labels == keep_label`` filters keep nothing instead of
+    crashing on an empty argmax.
+    """
     import numpy as np
 
     lab = np.asarray(labels)[np.asarray(node_mask)]
+    if lab.size == 0:
+        return -1
     uniq, counts = np.unique(lab, return_counts=True)
     return int(uniq[np.argmax(counts)])

@@ -492,6 +492,26 @@ def run_consensus_batch(
         return res
 
 
+def _write_box_file(
+    out_path, rep_xy, conf, rep_slot, box_size, num_particles
+) -> int:
+    """One micrograph's consensus BOX file from already-selected rows.
+
+    Output format matches reference run_ilp.py:120-129: rows sorted by
+    clique confidence (the written weight column) descending, optional
+    top-N cutoff.  Mixed-size ensembles write each row with its
+    representative picker's box size; the scalar case is the
+    reference format.  Returns the written row count.
+    """
+    sizes = np.asarray(box_size)
+    row_sizes = sizes[rep_slot] if sizes.ndim else box_size
+    box_io.write_box(
+        out_path, rep_xy, conf, row_sizes, num_particles=num_particles
+    )
+    n = len(rep_xy)
+    return n if num_particles is None else min(n, num_particles)
+
+
 def write_consensus_boxes(
     batch: PaddedBatch,
     res: ConsensusResult,
@@ -500,40 +520,153 @@ def write_consensus_boxes(
     *,
     num_particles: int | None = None,
 ) -> dict[str, int]:
-    """Write one consensus BOX file per micrograph.
-
-    Output format matches reference run_ilp.py:120-129: rows sorted by
-    clique confidence (the written weight column) descending, optional
-    top-N cutoff.
-    """
+    """Write one consensus BOX file per micrograph."""
     os.makedirs(out_dir, exist_ok=True)
     # one batched fetch for all four output arrays (per-array fetches
     # each pay a device round trip — expensive over a tunneled TPU)
     picked, rep_xy, confidence, rep_slot = jax.device_get(
         (res.picked, res.rep_xy, res.confidence, res.rep_slot)
     )
-    sizes = np.asarray(box_size)
     counts = {}
     for i, name in enumerate(batch.names):
         if not name:
             continue
         sel = np.where(picked[i])[0]
-        out = os.path.join(out_dir, name + ".box")
-        # mixed-size ensembles write each row with its representative
-        # picker's box size; the scalar case is the reference format
-        row_sizes = (
-            sizes[rep_slot[i, sel]] if sizes.ndim else box_size
-        )
-        box_io.write_box(
-            out,
+        counts[name] = _write_box_file(
+            os.path.join(out_dir, name + ".box"),
             rep_xy[i, sel],
             confidence[i, sel],
-            row_sizes,
-            num_particles=num_particles,
+            rep_slot[i, sel],
+            box_size,
+            num_particles,
         )
-        counts[name] = len(sel) if num_particles is None else min(
-            len(sel), num_particles
+    return counts
+
+
+def _cc_keep_mask(member_idx, labels, node_mask):
+    """Bool mask over cliques inside the largest connected component.
+
+    Mirrors the two-phase filter (commands/get_cliques.py): a clique
+    belongs to the component of its anchor-picker member (all members
+    of a clique share a component by construction — they are pairwise
+    connected).
+    """
+    from repic_tpu.ops.components import largest_component_label
+
+    keep_label = largest_component_label(labels, node_mask)
+    return np.asarray(labels)[0, member_idx[:, 0]] == keep_label
+
+
+def write_consensus_tables(
+    part,
+    res: ConsensusResult,
+    cc,
+    out_dir: str,
+    box_size,
+    pickers,
+    *,
+    multi_out: bool = False,
+    get_cc: bool = False,
+    num_particles: int | None = None,
+) -> dict[str, int]:
+    """Fused-path writer for the ``--multi_out`` / ``--get_cc`` surface.
+
+    Produces, per micrograph, exactly what the two-phase
+    ``get_cliques`` + ``run_ilp`` pair produces for the same flags
+    (reference: run_ilp.py:93-119 for the multi-out TSV,
+    get_cliques.py:151-156 for the largest-CC filter), so the fused
+    fast path covers the reference's full flag surface:
+
+    * ``multi_out``: ``{name}.tsv`` — header of picker names, one row
+      per chosen clique with that picker's member coordinates in each
+      column, then every vertex not in a chosen clique re-added as a
+      confidence-0 singleton row (sorted by coordinate per picker).
+    * ``get_cc``: restrict to cliques whose members lie in the largest
+      connected overlap component.  Applied to the solver's picks:
+      the packing problem decomposes over connected components (no
+      constraint or dominance relation crosses a component boundary),
+      so solve-then-filter equals filter-then-solve.
+
+    ``res`` and ``cc`` must already be host arrays (``fetch=True`` on
+    :func:`iter_consensus_chunks`); ``part`` is the chunk's
+    ``(name, sets)`` slice whose order matches the batch rows.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    counts: dict[str, int] = {}
+    labels_b, node_mask_b = cc if cc is not None else (None, None)
+    for i, (name, sets) in enumerate(part):
+        k = len(sets)
+        valid = np.asarray(res.valid[i])
+        member_idx = np.asarray(res.member_idx[i])[valid]
+        conf = np.asarray(res.confidence[i])[valid]
+        picked = np.asarray(res.picked[i])[valid]
+        rep_xy = np.asarray(res.rep_xy[i])[valid]
+        rep_slot = np.asarray(res.rep_slot[i])[valid]
+        if get_cc:
+            keep = _cc_keep_mask(member_idx, labels_b[i], node_mask_b[i])
+            member_idx, conf, picked = (
+                member_idx[keep], conf[keep], picked[keep]
+            )
+            rep_xy, rep_slot = rep_xy[keep], rep_slot[keep]
+
+        chosen = np.where(picked)[0]
+        if not multi_out:
+            # get_cc single-out: reference BOX format over the kept
+            # cliques only (run_ilp.py:120-129 semantics).
+            counts[name] = _write_box_file(
+                os.path.join(out_dir, name + ".box"),
+                rep_xy[chosen],
+                conf[chosen],
+                rep_slot[chosen],
+                box_size,
+                num_particles,
+            )
+            continue
+
+        # Multi-out TSV.  Chosen cliques first (enumeration order, as
+        # the two-phase pickle order), then per picker every vertex of
+        # the (CC-filtered) universe not covered by a chosen clique as
+        # a confidence-0 singleton, sorted by (x, y, particle) — the
+        # reference sorts (x, y, id) tuples and id increases with the
+        # particle index inside a picker.  Coordinate gather/rounding
+        # is vectorized; a clique row's cell layout ("x<TAB>y" per
+        # picker) is just its flattened int coordinates tab-joined.
+        node_int = np.rint(
+            np.stack(
+                [sets[p].xy[member_idx[chosen, p]] for p in range(k)],
+                axis=1,
+            )
+        ).astype(np.int64) if len(chosen) else np.zeros(
+            (0, k, 2), np.int64
         )
+        rows = [
+            "\t".join(map(str, node_int[c].ravel()))
+            + "\t" + str(float(conf[i_c]))
+            for c, i_c in enumerate(chosen)
+        ]
+        for p in range(k):
+            universe = (
+                np.unique(member_idx[:, p])
+                if get_cc
+                else np.arange(sets[p].n)
+            )
+            covered = (
+                np.unique(member_idx[chosen, p])
+                if len(chosen)
+                else np.empty(0, np.int64)
+            )
+            extras = np.setdiff1d(universe, covered)
+            xy_e = sets[p].xy[extras]
+            order = np.lexsort((extras, xy_e[:, 1], xy_e[:, 0]))
+            xy_int = np.rint(xy_e[order]).astype(np.int64)
+            for x, y in xy_int:
+                cells = ["N/A\tN/A"] * k
+                cells[p] = f"{x}\t{y}"
+                rows.append("\t".join(cells) + "\t0.0")
+        with open(os.path.join(out_dir, name + ".tsv"), "wt") as o:
+            o.write("\t".join(pickers) + "\n")
+            o.write("\n".join(rows))
+        counts[name] = len(chosen)
     return counts
 
 
@@ -586,8 +719,15 @@ def run_consensus_dir(
     spatial: bool | None = None,
     solver: str = "greedy",
     use_pallas: bool = False,
+    multi_out: bool = False,
+    get_cc: bool = False,
 ) -> dict:
     """End-to-end: read picker BOX dirs, consensus, write BOX files.
+
+    ``multi_out`` / ``get_cc`` select the reference get_cliques flag
+    surface on this fused path (per-picker TSVs / largest-CC filter),
+    equal to the two-phase pipeline's output for the same flags —
+    see :func:`write_consensus_tables`.
 
     Directory layout matches the reference (``in_dir/<picker>/*.box``,
     reference: get_cliques.py:81-105); micrographs missing from any
@@ -662,12 +802,30 @@ def run_consensus_dir(
 
     timer.stages.append(("load", time.time() - t0))
     n_dev = len(jax.devices()) if use_mesh else 1
+    want_tables = multi_out or get_cc
+    cc_fn = None
+    if get_cc:
+        from repic_tpu.ops.components import connected_component_labels
+
+        # Same scalar-or-per-picker size argument the clique graph
+        # uses, so the CC filter judges the graph the cliques came
+        # from (a max-size approximation would add/drop edges on
+        # mixed-size ensembles).
+        cc_sizes = np.asarray(box_size, np.float32)
+        cc_arg = cc_sizes if cc_sizes.ndim else float(box_size)
+        cc_fn = jax.jit(
+            jax.vmap(
+                lambda xy, mask: connected_component_labels(
+                    xy, mask, cc_arg, threshold=threshold
+                )
+            )
+        )
     compute_s = 0.0
     write_s = 0.0
     counts: dict = {}
     num_cliques = 0
     parts = []
-    for part, cbatch, res, _extra, chunk_s in iter_consensus_chunks(
+    for part, cbatch, res, extra, chunk_s in iter_consensus_chunks(
         loaded,
         box_size,
         n_dev=n_dev,
@@ -677,16 +835,32 @@ def run_consensus_dir(
         spatial=spatial,
         solver=solver,
         use_pallas=use_pallas,
+        extra_device_outputs=(
+            None
+            if cc_fn is None
+            else lambda b: cc_fn(jnp.asarray(b.xy), jnp.asarray(b.mask))
+        ),
+        fetch=want_tables,
     ):
         parts.append(len(part))
         compute_s += chunk_s
         t2 = time.time()
-        counts.update(
-            write_consensus_boxes(
-                cbatch, res, out_dir, box_size,
-                num_particles=num_particles,
+        if want_tables:
+            counts.update(
+                write_consensus_tables(
+                    part, res, extra, out_dir, box_size, pickers,
+                    multi_out=multi_out,
+                    get_cc=get_cc,
+                    num_particles=num_particles,
+                )
             )
-        )
+        else:
+            counts.update(
+                write_consensus_boxes(
+                    cbatch, res, out_dir, box_size,
+                    num_particles=num_particles,
+                )
+            )
         write_s += time.time() - t2
         num_cliques += int(np.sum(np.asarray(res.num_cliques)))
     timer.stages.append(("compute", compute_s))

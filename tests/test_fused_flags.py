@@ -1,0 +1,159 @@
+"""The fused ``consensus`` path's --multi_out / --get_cc flags must
+produce byte-identical outputs to the two-phase ``get_cliques`` +
+``run_ilp`` pipeline for the same flags and solver backend.
+
+This closes the capability asymmetry where the reference's full
+get_cliques flag surface (reference: repic/commands/
+get_cliques.py:151-156,175-178 and run_ilp.py:93-119) existed only on
+the slow two-phase compatibility path: the fused single-pass program
+now writes the same multi-out TSVs (per-picker columns + confidence-0
+singleton re-adds) and honors the largest-connected-component filter.
+
+Equality holds exactly because the packing problem decomposes over
+connected components (no constraint crosses a component boundary), so
+the fused solve-everything-then-filter equals the two-phase
+filter-then-solve; and the singleton re-add universe in run_ilp's TSV
+is recoverable from the fused result's member indices.
+"""
+
+import os
+import shutil
+from types import SimpleNamespace
+
+import pytest
+
+from tests.conftest import REFERENCE_EXAMPLES, needs_reference
+
+NAMES = (
+    "Falcon_2012_06_12-14_33_35_0",
+    "Falcon_2012_06_12-15_17_31_0",
+)
+
+
+def _stage_subset(tmp_path):
+    stage = tmp_path / "in"
+    for p in os.listdir(REFERENCE_EXAMPLES):
+        src = os.path.join(REFERENCE_EXAMPLES, p)
+        if not os.path.isdir(src):
+            continue
+        (stage / p).mkdir(parents=True)
+        for n in NAMES:
+            shutil.copy(os.path.join(src, n + ".box"), stage / p)
+    return str(stage)
+
+
+def _two_phase(tmp_path, in_dir, tag, *, multi_out, get_cc, backend):
+    from repic_tpu.commands import get_cliques, run_ilp
+
+    out = str(tmp_path / f"p_{tag}")
+    get_cliques.main(
+        SimpleNamespace(
+            in_dir=in_dir,
+            out_dir=out,
+            box_size=180,
+            multi_out=multi_out,
+            get_cc=get_cc,
+            max_neighbors=16,
+            no_mesh=True,
+        )
+    )
+    run_ilp.main(
+        SimpleNamespace(
+            in_dir=out, box_size=180, num_particles=None, backend=backend
+        )
+    )
+    return out
+
+
+@needs_reference
+@pytest.mark.parametrize(
+    "multi_out,get_cc,solver,use_mesh",
+    [
+        (True, False, "greedy", False),
+        (False, True, "greedy", True),   # sharded over the CPU mesh
+        (True, True, "greedy", False),
+        (True, False, "lp", False),
+        (False, True, "lp", False),
+        (True, True, "lp", False),
+    ],
+)
+def test_fused_flags_equal_two_phase(
+    tmp_path, multi_out, get_cc, solver, use_mesh
+):
+    from repic_tpu.pipeline.consensus import run_consensus_dir
+
+    in_dir = _stage_subset(tmp_path)
+    tag = f"{int(multi_out)}{int(get_cc)}_{solver}"
+    ref = _two_phase(
+        tmp_path, in_dir, tag,
+        multi_out=multi_out, get_cc=get_cc, backend=solver,
+    )
+    ours = str(tmp_path / f"f_{tag}")
+    run_consensus_dir(
+        in_dir,
+        ours,
+        180,
+        multi_out=multi_out,
+        get_cc=get_cc,
+        solver=solver,
+        use_mesh=use_mesh,
+    )
+    ext = ".tsv" if multi_out else ".box"
+    for n in NAMES:
+        with open(os.path.join(ref, n + ext)) as f:
+            want = f.read()
+        with open(os.path.join(ours, n + ext)) as f:
+            got = f.read()
+        assert got == want, f"{n}{ext} ({tag})"
+
+
+def _write_box_dir(root, picker, name, rows):
+    d = root / picker
+    d.mkdir(parents=True, exist_ok=True)
+    with open(d / (name + ".box"), "wt") as f:
+        for x, y, s, c in rows:
+            f.write(f"{x}\t{y}\t{s}\t{s}\t{c}\n")
+
+
+@pytest.mark.parametrize("multi_out", [False, True])
+def test_get_cc_empty_graph_micrograph(tmp_path, multi_out):
+    """A micrograph with no above-threshold edge must produce an empty
+    output under --get_cc, not crash on an empty largest-CC argmax
+    (regression: largest_component_label on a node-less graph)."""
+    from repic_tpu.pipeline.consensus import run_consensus_dir
+
+    src = tmp_path / "in"
+    # two pickers, one box each, far apart: zero overlap edges
+    _write_box_dir(src, "a", "m0", [(10, 10, 180, 0.9)])
+    _write_box_dir(src, "b", "m0", [(5000, 5000, 180, 0.8)])
+    out = str(tmp_path / "out")
+    stats = run_consensus_dir(
+        str(src), out, 180,
+        multi_out=multi_out, get_cc=True, use_mesh=False,
+    )
+    assert stats["particle_counts"] == {"m0": 0}
+    if multi_out:
+        with open(os.path.join(out, "m0.tsv")) as f:
+            assert f.read() == "a\tb\n"
+    else:
+        assert os.path.getsize(os.path.join(out, "m0.box")) == 0
+
+
+def test_cc_labels_use_per_picker_sizes():
+    """Mixed-size ensembles: CC edges must be judged with the same
+    per-picker box sizes as the clique graph.  A 100-px and a 20-px
+    box at the same center have IoU 0.04 (< 0.3, no edge); a max-size
+    scalar approximation would call it IoU 1.0 and invent an edge."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from repic_tpu.ops.components import connected_component_labels
+
+    xy = jnp.zeros((2, 1, 2), jnp.float32)
+    mask = jnp.ones((2, 1), bool)
+    _, node_mask = connected_component_labels(
+        xy, mask, jnp.asarray([100.0, 20.0])
+    )
+    assert not bool(np.asarray(node_mask).any())
+    _, node_mask = connected_component_labels(xy, mask, 100.0)
+    assert bool(np.asarray(node_mask).all())
