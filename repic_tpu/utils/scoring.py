@@ -176,6 +176,33 @@ def match_by_stem(gt_paths, pckr_paths, gt_ext=".box", pckr_ext=".box"):
     return pairs
 
 
+def _converted_pairs(
+    gt_paths, pckr_paths, gt_fmt, pckr_fmt, box_size, sort=False
+):
+    """Pair GT/picker files by stem and convert both sides to
+    canonical BOX DataFrames (the shared front half of both metric
+    families).  Yields ``(stem, gt_df, pckr_df)``."""
+    from repic_tpu.utils.coords import convert
+
+    pairs = match_by_stem(
+        gt_paths, pckr_paths,
+        gt_ext=f".{gt_fmt}", pckr_ext=f".{pckr_fmt}",
+    )
+    if sort:
+        pairs = sorted(pairs)
+    assert len(pairs) > 0, (
+        "No paired ground truth and picker particle sets found"
+    )
+    for stem, g, p in pairs:
+        gt_df = next(iter(convert(
+            [g], gt_fmt, "box", boxsize=box_size, quiet=True
+        ).values()))
+        p_df = next(iter(convert(
+            [p], pckr_fmt, "box", boxsize=box_size, quiet=True
+        ).values()))
+        yield stem, gt_df, p_df
+
+
 def score_box_files(
     gt_paths,
     pckr_paths,
@@ -197,23 +224,10 @@ def score_box_files(
     inline.  Centered formats (star/tsv/cs) need ``box_size`` for the
     center->corner shift.
     """
-    from repic_tpu.utils.coords import convert
-
-    pairs = match_by_stem(
-        gt_paths, pckr_paths,
-        gt_ext=f".{gt_fmt}", pckr_ext=f".{pckr_fmt}",
-    )
-    assert len(pairs) > 0, (
-        "No paired ground truth and picker particle sets found"
-    )
     rows = []
-    for stem, g, p in pairs:
-        gt_df = next(iter(convert(
-            [g], gt_fmt, "box", boxsize=box_size, quiet=True
-        ).values()))
-        p_df = next(iter(convert(
-            [p], pckr_fmt, "box", boxsize=box_size, quiet=True
-        ).values()))
+    for stem, gt_df, p_df in _converted_pairs(
+        gt_paths, pckr_paths, gt_fmt, pckr_fmt, box_size
+    ):
         for df in (gt_df, p_df):
             if "conf" not in df.columns:
                 df["conf"] = 1
@@ -227,6 +241,48 @@ def score_box_files(
             )
         rows.append((stem, *scores))
     return rows
+
+
+def score_distance_files(
+    gt_paths,
+    pckr_paths,
+    particle_size,
+    rate=0.2,
+    gt_fmt="star",
+    pckr_fmt="box",
+    box_size=None,
+):
+    """Distance-matching analysis over matched (GT, picker) pairs.
+
+    The second metric family the reference offers (vendored
+    DeepPicker ``analysis_pick_results``, docs/patches/deeppicker/
+    autoPicker.py:336-420): center-distance greedy matching with
+    TP iff distance < ``rate * particle_size`` — see
+    :mod:`repic_tpu.utils.matching`.  Pairs are processed in sorted
+    stem order (the curve's tie order).  Either side may be any
+    converter-registry format; coordinates are reduced to box centers.
+    """
+
+    def centers(df):
+        if len(df) == 0:
+            return np.zeros((0, 2), np.float64)
+        arr = df[["x", "y", "w", "h"]].to_numpy(np.float64)
+        return arr[:, :2] + arr[:, 2:] / 2.0
+
+    triples = []
+    for _stem, gt_df, p_df in _converted_pairs(
+        gt_paths, pckr_paths, gt_fmt, pckr_fmt,
+        box_size or particle_size, sort=True,
+    ):
+        conf = (
+            p_df["conf"].to_numpy(np.float64)
+            if "conf" in p_df.columns and len(p_df)
+            else np.ones(len(p_df), np.float64)
+        )
+        triples.append((centers(p_df), conf, centers(gt_df)))
+    from repic_tpu.utils.matching import analyze_distance_matches
+
+    return analyze_distance_matches(triples, particle_size, rate=rate)
 
 
 def write_scores_tsv(rows, out_dir) -> str:
@@ -275,7 +331,22 @@ def add_arguments(parser) -> None:
     parser.add_argument(
         "--box_size", type=int, default=None,
         help="particle box size; required when a centered format "
-        "(star/tsv/cs) is scored",
+        "(star/tsv/cs) is scored, and the particle size for "
+        "--match distance",
+    )
+    parser.add_argument(
+        "--match",
+        choices=["mask", "distance"],
+        default="mask",
+        help="metric family: segmentation-mask pixel overlap "
+        "(reference score_detections.py), or center-distance greedy "
+        "matching with TP iff dist < dist_rate * box_size (the "
+        "vendored DeepPicker's analysis_pick_results)",
+    )
+    parser.add_argument(
+        "--dist_rate", type=float, default=0.2,
+        help="--match distance: match radius as a fraction of "
+        "box_size (reference default 0.2)",
     )
 
 
@@ -285,6 +356,35 @@ def main(args) -> None:
         os.makedirs(out_dir, exist_ok=True)
     else:
         out_dir = os.path.dirname(args.p[0]) or "."
+    if args.match == "distance":
+        from repic_tpu.utils.matching import write_results_txt
+
+        assert args.box_size is not None, (
+            "--match distance needs --box_size (the particle size "
+            "setting the match radius)"
+        )
+        # Mask-mode-only knobs must not be silently ignored: the
+        # distance analysis pins its own 0.5 threshold (the reference
+        # protocol) and never rasterizes, so -c/--height/--width
+        # cannot take effect.
+        assert args.c is None and args.height is None and args.width is None, (
+            "-c/--height/--width apply to --match mask only; the "
+            "distance analysis uses the reference's fixed 0.5 "
+            "threshold and no rasterization"
+        )
+        analysis = score_distance_files(
+            args.g, args.p, args.box_size, rate=args.dist_rate,
+            gt_fmt=args.gt_format, pckr_fmt=args.pckr_format,
+            box_size=args.box_size,
+        )
+        out_file = write_results_txt(analysis, out_dir)
+        print(
+            "(threshold 0.5)precision:%f recall:%f"
+            % (analysis["precision_05"], analysis["recall_05"])
+        )
+        if args.verbose:
+            print(f"wrote {out_file}")
+        return
     rows = score_box_files(
         args.g, args.p, conf_thresh=args.c,
         mrc_w=args.width, mrc_h=args.height, verbose=args.verbose,

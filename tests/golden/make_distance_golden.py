@@ -1,0 +1,202 @@
+#!/usr/bin/env python3
+"""Generate the distance-analysis golden by EXECUTING the reference.
+
+Synthesizes a deterministic 3-micrograph fixture (committed under
+tests/fixtures/distance/): integer-coordinate ground-truth ``.star``
+files plus picker ``.box`` files whose centers jitter around a subset
+of the references, with decoys, near-threshold distances, and duplicate
+confidences (to pin the stable sort).
+
+Then extracts the REAL ``calculate_tp`` and ``analysis_pick_results``
+function bodies from the vendored DeepPicker
+(/root/reference/docs/patches/deeppicker/autoPicker.py:336-507) via
+ast, executes them on the pickle-format input they expect, and commits
+the ``results.txt`` they write as ``tests/golden/ref_distance_results.txt``
+plus the threshold-0.5 stdout stats as
+``ref_distance_stats.json``.
+
+Only ``DataLoader.read_coordinate_from_star`` is stubbed (the star
+parse, whose int-truncation is a no-op on this integer fixture) — all
+matching and curve math is the reference's own executed code.
+
+Run from the repo root with the reference mounted:
+    python tests/golden/make_distance_golden.py
+"""
+
+import ast
+import contextlib
+import io
+import json
+import math
+import os
+import pickle
+import shutil
+import tempfile
+from operator import itemgetter
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURE = os.path.join(os.path.dirname(HERE), "fixtures", "distance")
+REF_FILE = "/root/reference/docs/patches/deeppicker/autoPicker.py"
+
+SIZE = 40          # particle size -> match radius 0.2 * 40 = 8
+MICROGRAPHS = ["mic_a", "mic_b", "mic_c"]
+
+
+def synth_fixture():
+    rng = np.random.default_rng(20260731)
+    if os.path.isdir(FIXTURE):
+        shutil.rmtree(FIXTURE)
+    os.makedirs(FIXTURE)
+    data = {}
+    for m, name in enumerate(MICROGRAPHS):
+        n_ref = 24 + 4 * m
+        refs = rng.integers(60, 940, size=(n_ref, 2))
+        picks = []
+        # hits: jitter within the radius around ~70% of refs
+        for i, (rx, ry) in enumerate(refs):
+            if rng.random() < 0.7:
+                ang = rng.uniform(0, 2 * np.pi)
+                rad = rng.uniform(0.5, 7.5)
+                picks.append(
+                    (rx + rad * np.cos(ang), ry + rad * np.sin(ang))
+                )
+            # competing second pick near some refs (greedy claim order)
+            if rng.random() < 0.25:
+                ang = rng.uniform(0, 2 * np.pi)
+                rad = rng.uniform(2.0, 7.9)
+                picks.append(
+                    (rx + rad * np.cos(ang), ry + rad * np.sin(ang))
+                )
+        # near-threshold misses (just outside) and far decoys
+        for _ in range(6):
+            rx, ry = refs[rng.integers(len(refs))]
+            ang = rng.uniform(0, 2 * np.pi)
+            rad = rng.uniform(8.1, 9.5)
+            picks.append((rx + rad * np.cos(ang), ry + rad * np.sin(ang)))
+        for _ in range(8):
+            picks.append(tuple(rng.uniform(1000, 2000, size=2)))
+        # snap centers to 1/8 px (dyadic): the .box corner round-trip
+        # (center - SIZE/2 + SIZE/2) is then exact in float64, so the
+        # executed reference and the framework see bit-identical centers
+        picks = np.round(np.asarray(picks, np.float64) * 8) / 8
+        # confidences with deliberate duplicates across micrographs
+        conf = np.round(rng.uniform(0.05, 0.99, size=len(picks)), 2)
+        data[name] = (refs, picks, conf)
+
+        with open(os.path.join(FIXTURE, name + ".star"), "wt") as f:
+            f.write("\ndata_\n\nloop_\n_rlnCoordinateX #1\n"
+                    "_rlnCoordinateY #2\n")
+            for x, y in refs:
+                f.write(f"{x}\t{y}\n")
+        with open(os.path.join(FIXTURE, name + ".box"), "wt") as f:
+            for (cx, cy), c in zip(picks, conf):
+                f.write(
+                    f"{float(cx - SIZE / 2)!r}\t"
+                    f"{float(cy - SIZE / 2)!r}\t"
+                    f"{SIZE}\t{SIZE}\t{float(c)!r}\n"
+                )
+    return data
+
+
+def extract_reference_functions():
+    """Compile the reference's calculate_tp / analysis_pick_results
+    (stripped of their @staticmethod decorators) as plain functions."""
+    tree = ast.parse(open(REF_FILE).read())
+    wanted = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "AutoPicker":
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef) and item.name in (
+                    "calculate_tp", "analysis_pick_results",
+                ):
+                    item.decorator_list = []
+                    wanted[item.name] = item
+    assert set(wanted) == {"calculate_tp", "analysis_pick_results"}
+
+    class _DataLoader:
+        """Star-parse stub (int truncation per dataLoader.py:223-224 —
+        a no-op on the integer fixture)."""
+
+        @staticmethod
+        def read_coordinate_from_star(path):
+            out = []
+            for line in open(path):
+                parts = line.split()
+                if len(parts) == 2 and not parts[0].startswith("_"):
+                    out.append([int(float(parts[0])),
+                                int(float(parts[1]))])
+            return out
+
+    ns = {
+        "math": math, "itemgetter": itemgetter, "os": os,
+        "pickle": pickle, "DataLoader": _DataLoader,
+    }
+    for name, node in wanted.items():
+        mod = ast.Module(body=[node], type_ignores=[])
+        ast.fix_missing_locations(mod)
+        exec(compile(mod, REF_FILE, "exec"), ns)
+
+    class _AutoPicker:
+        calculate_tp = staticmethod(ns["calculate_tp"])
+
+    ns["AutoPicker"] = _AutoPicker
+    return ns["analysis_pick_results"]
+
+
+def main():
+    data = synth_fixture()
+    analysis = extract_reference_functions()
+
+    tmp = tempfile.mkdtemp(prefix="dist_golden_")
+    ref_dir = os.path.join(tmp, "refs")
+    os.makedirs(ref_dir)
+    # pickle in the reference's format, micrographs in sorted-stem
+    # order (the order our CLI pairs files in)
+    coordinate = []
+    for name in sorted(MICROGRAPHS):
+        refs, picks, conf = data[name]
+        coordinate.append(
+            [
+                [float(x), float(y), float(c), name + ".mrc"]
+                for (x, y), c in zip(picks, conf)
+            ]
+        )
+        shutil.copy(
+            os.path.join(FIXTURE, name + ".star"),
+            os.path.join(ref_dir, name + ".star"),
+        )
+    pick_file = os.path.join(tmp, "autopick_results.pickle")
+    with open(pick_file, "wb") as f:
+        pickle.dump(coordinate, f)
+
+    stdout = io.StringIO()
+    with contextlib.redirect_stdout(stdout):
+        analysis(pick_file, ref_dir, "", SIZE, 0.2)
+
+    shutil.copy(
+        os.path.join(tmp, "results.txt"),
+        os.path.join(HERE, "ref_distance_results.txt"),
+    )
+    stats_line = [
+        ln for ln in stdout.getvalue().splitlines()
+        if ln.startswith("(threshold 0.5)")
+    ][0]
+    prec, rec = (
+        float(stats_line.split("precision:")[1].split()[0]),
+        float(stats_line.split("recall:")[1]),
+    )
+    with open(os.path.join(HERE, "ref_distance_stats.json"), "wt") as f:
+        json.dump(
+            {"precision_05": prec, "recall_05": rec,
+             "particle_size": SIZE, "rate": 0.2},
+            f, indent=1,
+        )
+    shutil.rmtree(tmp)
+    print("golden written:", os.path.join(HERE, "ref_distance_results.txt"))
+    print(stats_line)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,164 @@
+"""Distance-based pick analysis vs the EXECUTED reference routine.
+
+``tests/golden/ref_distance_results.txt`` is the ``results.txt``
+written by the vendored DeepPicker's own ``analysis_pick_results`` /
+``calculate_tp`` code (extracted by ast and executed —
+tests/golden/make_distance_golden.py) on the committed fixture
+``tests/fixtures/distance/``.  The framework's ``score --match
+distance`` mode must reproduce it byte for byte, plus the
+threshold-0.5 precision/recall the reference prints.
+
+Unit tests pin the greedy protocol's order semantics that the golden
+alone might mask: earlier references steal, ties break to the lowest
+pick index, the radius comparison is strict, and degenerate inputs
+(no picks / no refs / no matches) return instead of dividing by zero
+(where the reference crashes — documented divergence).
+"""
+
+import glob
+import json
+import os
+from types import SimpleNamespace
+
+import numpy as np
+
+from repic_tpu.utils.matching import (
+    analyze_distance_matches,
+    greedy_center_match,
+    write_results_txt,
+)
+
+HERE = os.path.dirname(__file__)
+FIXTURE = os.path.join(HERE, "fixtures", "distance")
+GOLDEN = os.path.join(HERE, "golden", "ref_distance_results.txt")
+STATS = os.path.join(HERE, "golden", "ref_distance_stats.json")
+
+
+def _fixture_files():
+    return (
+        sorted(glob.glob(os.path.join(FIXTURE, "*.star"))),
+        sorted(glob.glob(os.path.join(FIXTURE, "*.box"))),
+    )
+
+
+def test_results_txt_matches_executed_reference(tmp_path):
+    from repic_tpu.utils.scoring import score_distance_files
+
+    with open(STATS) as f:
+        stats = json.load(f)
+    gt, picks = _fixture_files()
+    analysis = score_distance_files(
+        gt, picks, stats["particle_size"], rate=stats["rate"],
+        gt_fmt="star", pckr_fmt="box",
+    )
+    out = write_results_txt(analysis, str(tmp_path))
+    with open(GOLDEN) as f:
+        want = f.read()
+    with open(out) as f:
+        got = f.read()
+    assert got == want
+    np.testing.assert_allclose(
+        analysis["precision_05"], stats["precision_05"], atol=5e-7
+    )
+    np.testing.assert_allclose(
+        analysis["recall_05"], stats["recall_05"], atol=5e-7
+    )
+
+
+def test_score_cli_distance_mode(tmp_path, capsys):
+    from repic_tpu.utils import scoring
+
+    with open(STATS) as f:
+        stats = json.load(f)
+    gt, picks = _fixture_files()
+    scoring.main(
+        SimpleNamespace(
+            g=gt, p=picks, c=None, height=None, width=None,
+            verbose=False, out_dir=str(tmp_path),
+            gt_format="star", pckr_format="box",
+            box_size=stats["particle_size"],
+            match="distance", dist_rate=stats["rate"],
+        )
+    )
+    assert os.path.isfile(tmp_path / "results.txt")
+    line = [
+        ln for ln in capsys.readouterr().out.splitlines()
+        if ln.startswith("(threshold 0.5)")
+    ][0]
+    assert f"precision:{stats['precision_05']:.6f}" in line
+
+
+def test_greedy_earlier_reference_steals():
+    # one pick between two refs, closer to the second — but ref 0
+    # claims first in file order (the reference's loop order)
+    picks = [(5.0, 0.0)]
+    refs = [(0.0, 0.0), (7.0, 0.0)]
+    matched, dist = greedy_center_match(picks, refs, radius=6.0)
+    assert matched.tolist() == [True]
+    np.testing.assert_allclose(dist, [5.0])
+
+
+def test_greedy_tie_breaks_to_lowest_pick_index():
+    picks = [(3.0, 0.0), (-3.0, 0.0)]
+    refs = [(0.0, 0.0)]
+    matched, _ = greedy_center_match(picks, refs, radius=4.0)
+    assert matched.tolist() == [True, False]
+
+
+def test_radius_is_strict():
+    matched, _ = greedy_center_match(
+        [(8.0, 0.0)], [(0.0, 0.0)], radius=8.0
+    )
+    assert not matched.any()
+    matched, _ = greedy_center_match(
+        [(7.999, 0.0)], [(0.0, 0.0)], radius=8.0
+    )
+    assert matched.all()
+
+
+def test_each_pick_claimed_once():
+    # two refs near one pick: only the first ref gets it, the second
+    # must not re-claim
+    picks = [(0.0, 0.0)]
+    refs = [(1.0, 0.0), (2.0, 0.0)]
+    matched, dist = greedy_center_match(picks, refs, radius=5.0)
+    assert matched.tolist() == [True]
+    np.testing.assert_allclose(dist, [1.0])
+
+
+def test_degenerate_inputs_do_not_divide_by_zero():
+    m, d = greedy_center_match(
+        np.zeros((0, 2)), [(0.0, 0.0)], radius=5.0
+    )
+    assert len(m) == 0 and len(d) == 0
+    a = analyze_distance_matches(
+        [(np.zeros((0, 2)), np.zeros(0), [(0.0, 0.0)])],
+        particle_size=40,
+    )
+    assert a["precision_05"] == 0.0 and a["n_total"] == 0
+    # picks but no refs at all
+    a = analyze_distance_matches(
+        [([(1.0, 1.0)], [0.9], np.zeros((0, 2)))], particle_size=40
+    )
+    assert a["recall_05"] == 0.0 and a["tp"] == [0]
+
+
+def test_curve_sort_is_stable_for_equal_confidence():
+    # two picks with identical confidence: curve order must keep
+    # processing order (reference: stable sorted(reverse=True))
+    a = analyze_distance_matches(
+        [
+            ([(0.0, 0.0)], [0.7], [(1.0, 0.0)]),      # matched
+            ([(100.0, 100.0)], [0.7], [(300.0, 300.0)]),  # unmatched
+        ],
+        particle_size=40,
+    )
+    assert a["tp"] == [1, 1]
+    a2 = analyze_distance_matches(
+        [
+            ([(100.0, 100.0)], [0.7], [(300.0, 300.0)]),
+            ([(0.0, 0.0)], [0.7], [(1.0, 0.0)]),
+        ],
+        particle_size=40,
+    )
+    assert a2["tp"] == [0, 1]
