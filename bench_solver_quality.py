@@ -1,0 +1,169 @@
+#!/usr/bin/env python3
+"""Solver optimality at stress scale: greedy and LP vs the exact oracle.
+
+The example-scale suite gates the device solvers at >= 0.98
+particle-set Jaccard against the exact branch-and-bound on
+EMPIAR-10017 (tests/test_golden_10017.py) — 12 micrographs of a few
+hundred cliques.  This bench asks the same question where packing
+conflicts are deepest: the BASELINE stress configs —
+
+* ``stress``: 50k particles x 4 pickers per micrograph (configs[3]
+  density), dense jittered fields;
+* ``stress_hard``: the same field at 4x the picker jitter — ambiguous
+  cross-particle matches create deep clique conflicts (the regime
+  where greedy provably leaves objective on the table);
+* ``k5mixed``: 50k particles x 5 pickers with mixed box sizes
+  (configs[4] shape; sizes as tests/test_mixed_e2e.py).
+
+For each micrograph it runs the fused consensus once per device
+backend (greedy, lp), then solves the identical packing problem with
+the exact native branch-and-bound (ops/solver.py:solve_exact — the
+Gurobi replacement, reference run_ilp.py:50-63) and reports
+
+    objective ratio   sum(w[picked]) / sum(w[exact])
+    particle Jaccard  |reps_backend & reps_exact| / |union|
+    solver runtimes
+
+One JSON line per workload; ``--out`` also appends them to an artifact
+file (SOLVER_QUALITY_*.json) that docs/tpu.md numbers must cite.
+Forced to the CPU backend by default (solver quality is
+platform-independent; the TPU chip stays free for timing runs).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+from bench_stress import synthesize
+
+
+def _mixed_synthesize(m, n, seed=0):
+    """k=5 mixed-size stress field (sizes per tests/test_mixed_e2e.py)."""
+    sizes = np.asarray([180.0, 120.0, 180.0, 120.0, 180.0], np.float32)
+    xy, conf, mask = synthesize(m, 5, n, seed=seed)
+    return xy, conf, mask, sizes
+
+
+def run_workload(name, m, n, seed):
+    import jax
+
+    from repic_tpu.ops.solver import solve_exact
+    from repic_tpu.parallel.batching import PaddedBatch
+    from repic_tpu.pipeline.consensus import run_consensus_batch
+
+    if name == "stress":
+        k = 4
+        xy, conf, mask = synthesize(m, k, n, seed=seed)
+        box = 180.0
+    elif name == "stress_hard":
+        k = 4
+        xy, conf, mask = synthesize(m, k, n, seed=seed, jitter=40.0)
+        box = 180.0
+    elif name == "k5mixed":
+        k = 5
+        xy, conf, mask, box = _mixed_synthesize(m, n, seed=seed)
+    else:
+        raise SystemExit(f"unknown workload {name!r}")
+    batch = PaddedBatch(
+        xy=xy, conf=conf, mask=mask,
+        names=tuple(f"m{i}" for i in range(m)),
+        counts=np.full((m, k), n, np.int32),
+    )
+
+    res = {}
+    times = {}
+    for solver in ("greedy", "lp"):
+        t0 = time.time()
+        r = run_consensus_batch(
+            batch, box, use_mesh=False, solver=solver
+        )
+        jax.block_until_ready(r.picked)
+        times[solver] = time.time() - t0
+        res[solver] = jax.device_get(r)
+
+    out = {
+        "workload": name,
+        "micrographs": m,
+        "particles": n,
+        "pickers": k,
+        "per_micrograph": [],
+    }
+    for i in range(m):
+        valid = np.asarray(res["greedy"].valid[i])
+        mem = np.asarray(res["greedy"].member_idx[i])[valid]
+        w = np.asarray(res["greedy"].w[i])[valid].astype(np.float64)
+        rep = np.asarray(res["greedy"].rep_xy[i])[valid]
+        vid = mem + np.arange(k)[None, :] * batch.capacity
+        t0 = time.time()
+        picked_exact = solve_exact(vid, w)
+        exact_s = time.time() - t0
+        obj_exact = float(w[picked_exact].sum())
+        reps_exact = {tuple(r) for r in rep[picked_exact]}
+        row = {
+            "cliques": int(len(w)),
+            "obj_exact": round(obj_exact, 4),
+            "exact_solve_s": round(exact_s, 3),
+        }
+        for solver in ("greedy", "lp"):
+            rv = np.asarray(res[solver].valid[i])
+            picked = np.asarray(res[solver].picked[i])[rv]
+            wv = np.asarray(res[solver].w[i])[rv].astype(np.float64)
+            repv = np.asarray(res[solver].rep_xy[i])[rv]
+            obj = float(wv[picked].sum())
+            reps = {tuple(r) for r in repv[picked]}
+            union = reps | reps_exact
+            row[f"obj_ratio_{solver}"] = round(obj / obj_exact, 6)
+            row[f"jaccard_{solver}"] = round(
+                len(reps & reps_exact) / len(union) if union else 1.0, 6
+            )
+        out["per_micrograph"].append(row)
+
+    for solver in ("greedy", "lp"):
+        out[f"min_jaccard_{solver}"] = min(
+            r[f"jaccard_{solver}"] for r in out["per_micrograph"]
+        )
+        out[f"min_obj_ratio_{solver}"] = min(
+            r[f"obj_ratio_{solver}"] for r in out["per_micrograph"]
+        )
+        out[f"consensus_s_{solver}"] = round(times[solver], 2)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--workloads", default="stress,stress_hard,k5mixed",
+        help="comma-separated subset of stress,stress_hard,k5mixed",
+    )
+    ap.add_argument("--m", type=int, default=2, help="micrographs")
+    ap.add_argument("--n", type=int, default=50_000)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", help="append JSON lines to this artifact")
+    ap.add_argument(
+        "--device", action="store_true",
+        help="run on the default (device) backend instead of CPU",
+    )
+    args = ap.parse_args()
+
+    if not args.device:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    for wl in args.workloads.split(","):
+        out = run_workload(wl.strip(), args.m, args.n, args.seed)
+        line = json.dumps(out)
+        print(line, flush=True)
+        if args.out:
+            with open(args.out, "at") as f:
+                f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

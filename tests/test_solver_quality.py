@@ -1,0 +1,88 @@
+"""Solver optimality gates at stress density (VERDICT r4 item 2).
+
+The example-scale gates (tests/test_golden_10017.py) prove >= 0.98
+particle-set Jaccard vs the exact oracle on 12 real micrographs with
+shallow conflicts.  These gates run the same comparison where packing
+is hard: dense jittered fields at CI-feasible particle counts on the
+stress code path (spatial bucketing + anchor-chunked assembly), in
+three regimes —
+
+* the standard stress density (configs[3] shape, scaled),
+* a high-jitter variant whose ambiguous cross-picker matches create
+  deep clique conflicts (the regime where greedy demonstrably leaves
+  objective behind), and
+* the k=5 mixed-box-size ensemble (configs[4] shape, scaled).
+
+Full-scale (50k x 4) numbers are measured by bench_solver_quality.py
+and recorded in docs/tpu.md (artifact: SOLVER_QUALITY_r5.json).
+"""
+
+import numpy as np
+import pytest
+
+from bench_solver_quality import _mixed_synthesize
+from bench_stress import synthesize
+from repic_tpu.ops.solver import solve_exact
+from repic_tpu.parallel.batching import PaddedBatch
+from repic_tpu.pipeline.consensus import run_consensus_batch
+
+N = 5000
+GATE = 0.98
+
+
+def _quality(batch, box, k, solver):
+    """(min objective ratio, min particle Jaccard) vs exact across the
+    batch's micrographs; asserts exact-solution feasibility inline."""
+    res = run_consensus_batch(batch, box, use_mesh=False, solver=solver)
+    ratios, jaccards = [], []
+    for i in range(len(batch.names)):
+        valid = np.asarray(res.valid[i])
+        mem = np.asarray(res.member_idx[i])[valid]
+        w = np.asarray(res.w[i])[valid].astype(np.float64)
+        rep = np.asarray(res.rep_xy[i])[valid]
+        picked = np.asarray(res.picked[i])[valid]
+        vid = mem + np.arange(k)[None, :] * batch.capacity
+        exact = solve_exact(vid, w)
+        # feasibility of the exact reference solution itself
+        used = vid[exact].ravel()
+        assert len(used) == len(set(used.tolist()))
+        obj, obj_exact = w[picked].sum(), w[exact].sum()
+        assert obj <= obj_exact + 1e-6
+        ratios.append(obj / obj_exact)
+        a = {tuple(r) for r in rep[picked]}
+        b = {tuple(r) for r in rep[exact]}
+        jaccards.append(len(a & b) / len(a | b) if a | b else 1.0)
+    return min(ratios), min(jaccards)
+
+
+def _batch(xy, conf, mask, k):
+    m = xy.shape[0]
+    return PaddedBatch(
+        xy=xy, conf=conf, mask=mask,
+        names=tuple(f"m{i}" for i in range(m)),
+        counts=np.full((m, k), xy.shape[2], np.int32),
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("solver", ["greedy", "lp"])
+@pytest.mark.parametrize(
+    "workload,jitter",
+    [("stress", 10.0), ("stress_hard", 40.0)],
+)
+def test_stress_density_within_gate_of_exact(workload, jitter, solver):
+    xy, conf, mask = synthesize(1, 4, N, seed=11, jitter=jitter)
+    ratio, jac = _quality(_batch(xy, conf, mask, 4), 180.0, 4, solver)
+    assert ratio >= GATE, f"{workload}/{solver}: objective ratio {ratio}"
+    assert jac >= GATE, f"{workload}/{solver}: particle Jaccard {jac}"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("solver", ["greedy", "lp"])
+def test_k5_mixed_within_gate_of_exact(solver):
+    xy, conf, mask, sizes = _mixed_synthesize(1, 4000, seed=11)
+    ratio, jac = _quality(
+        _batch(xy, conf, mask, 5), sizes, 5, solver
+    )
+    assert ratio >= GATE, f"k5mixed/{solver}: objective ratio {ratio}"
+    assert jac >= GATE, f"k5mixed/{solver}: particle Jaccard {jac}"
