@@ -48,6 +48,41 @@ def test_initialize_survives_private_module_removal(monkeypatch):
 
 
 @pytest.mark.slow
+def test_throughput_bench_end_to_end(tmp_path):
+    """bench_distributed.py must run both measurements and emit a
+    well-formed JSON line.  No timing gate: on this 1-core container
+    a two-process wall-clock speedup is impossible by construction
+    (docs/tpu.md records the measured coordination overhead instead);
+    the speedup claim is gated by the artifact's `regime` field, not
+    a flaky CI timing assert."""
+    import json
+
+    repo_root = os.path.dirname(os.path.dirname(__file__))
+    out = tmp_path / "dist_bench.json"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(repo_root, "bench_distributed.py"),
+            "--reps", "1", "--out", str(out), "--timeout", "240",
+        ],
+        capture_output=True,
+        text=True,
+        # must exceed the bench's own sequential budget (two phases x
+        # --timeout plus startup slack) so the bench's diagnostics and
+        # worker cleanup fire before this outer kill does
+        timeout=560,
+        cwd=repo_root,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    rec = json.loads(out.read_text())
+    assert rec["single_proc_s"] > 0 and rec["two_proc_s"] > 0
+    expect_scaling = len(os.sched_getaffinity(0)) >= 2
+    assert rec["regime"].startswith(
+        "scaling" if expect_scaling else "overhead"
+    )
+
+
+@pytest.mark.slow
 def test_two_process_consensus_matches_single(tmp_path):
     port = _free_port()
     workers = []
