@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""Extract the reference's ODS supplementary spreadsheets to TSV.
+
+The reference distributes its picker/RELION parameter record and its
+results tables as OpenDocument spreadsheets
+(reference README.md:56, supp_data_files/supplemental_data_file_{2,3}.ods),
+which need an office suite to read.  This renders each sheet to a
+plain TSV next to the committed ODS (``*_sheet_<name>.tsv``) so the
+content is greppable and diffable; cells are tab-joined with trailing
+empties trimmed.
+
+Run from the repo root (no arguments; operates on
+``supp_data/reference_files/``):
+    python supp_data/extract_ods.py
+"""
+
+import os
+import xml.etree.ElementTree as ET
+import zipfile
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FILES = os.path.join(HERE, "reference_files")
+TABLE_NS = "{urn:oasis:names:tc:opendocument:xmlns:table:1.0}"
+TEXT_NS = "{urn:oasis:names:tc:opendocument:xmlns:text:1.0}"
+# repeated-cell cap: ODS pads rows to 2^14 columns with one repeated
+# empty cell; real data never legitimately repeats this wide
+MAX_REPEAT = 64
+
+
+def sheet_rows(sheet):
+    rows = []
+    for row in sheet.iter(TABLE_NS + "table-row"):
+        cells = []
+        # Walk the row's direct children in document order: a
+        # covered-table-cell is a merged-cell placeholder and still
+        # occupies its column — skipping it (as a bare table-cell
+        # iteration would) shifts every later value one column left,
+        # attributing data to the wrong dataset.
+        for cell in row:
+            if cell.tag == TABLE_NS + "table-cell":
+                text = " ".join(
+                    "".join(p.itertext())
+                    for p in cell.iter(TEXT_NS + "p")
+                )
+            elif cell.tag == TABLE_NS + "covered-table-cell":
+                text = ""
+            else:
+                continue
+            rep = int(
+                cell.get(TABLE_NS + "number-columns-repeated", "1")
+            )
+            cells.extend([text] * min(rep, MAX_REPEAT))
+        while cells and cells[-1] == "":
+            cells.pop()
+        rows.append(cells)
+    while rows and not rows[-1]:
+        rows.pop()
+    return rows
+
+
+def extract(ods_path):
+    written = []
+    with zipfile.ZipFile(ods_path) as z:
+        root = ET.fromstring(z.read("content.xml"))
+    for sheet in root.iter(TABLE_NS + "table"):
+        name = sheet.get(TABLE_NS + "name")
+        out = (
+            os.path.splitext(ods_path)[0]
+            + f"_sheet_{name.replace(' ', '_')}.tsv"
+        )
+        rows = sheet_rows(sheet)
+        with open(out, "wt", encoding="utf-8") as f:
+            for cells in rows:
+                f.write("\t".join(cells) + "\n")
+        written.append(out)
+    return written
+
+
+def main():
+    for n in (2, 3):
+        ods = os.path.join(
+            FILES, f"supplemental_data_file_{n}.ods"
+        )
+        for out in extract(ods):
+            print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
