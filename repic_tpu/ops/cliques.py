@@ -101,6 +101,12 @@ def _per_picker_sizes(box_size, k: int, dtype) -> jax.Array:
 # product's D^(K-1) work/memory dwarfs the survivors.
 _STAGED_DPROD = 256
 
+# Largest neighbor capacity the Pallas kernel is asked to carry: its
+# top-D state spans ceil((D+1)/128) lane blocks (any D works), but the
+# merge is D unrolled passes, so past this cap the XLA matrix path is
+# the better program and enumerate_cliques falls back with a warning.
+_PALLAS_MAX_D = 256
+
 
 def enumerate_cliques(
     xy: jax.Array,
@@ -155,11 +161,19 @@ def enumerate_cliques(
         )
     D = min(max_neighbors, N)
     sizes = _per_picker_sizes(box_size, K, xy.dtype)
-    if use_pallas and D >= 128:
-        # the Pallas kernel's top-D state is one 128-lane block; the
-        # capacity-escalation loop can legitimately push D past it on
-        # pathological data — fall back to the XLA matrix path rather
-        # than crash mid-escalation
+    if use_pallas and D > _PALLAS_MAX_D:
+        # the kernel's top-D merge is D unrolled select-max passes, so
+        # a pathological escalation past this cap would mostly buy
+        # compile time; fall back to the XLA matrix path — loudly, so
+        # a disabled --pallas flag is never a silent mystery
+        import warnings
+
+        warnings.warn(
+            f"escalated neighbor capacity D={D} exceeds the Pallas "
+            f"kernel cap ({_PALLAS_MAX_D}); using the XLA matrix "
+            "path for this program",
+            stacklevel=2,
+        )
         use_pallas = False
 
     # Pairwise neighbor search for the anchor pairs (0, p) only;

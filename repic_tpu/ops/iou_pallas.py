@@ -12,15 +12,17 @@ repic/commands/get_cliques.py:59-69):
         running top-D  = select_D(concat(top-D, iou))   per anchor
 
 The ``(N, M)`` matrix never exists; per-step state is ``(TM, TN)`` in
-VMEM plus the ``(TM, LANE)`` running top-D written to the revisited
-output block — the classic TPU accumulation pattern (outputs indexed
-by ``i`` only are revisited across the sequential ``j`` steps).
+VMEM plus the running top-D (``ceil((D+1)/128)`` lane blocks) written
+to the revisited output block — the classic TPU accumulation pattern
+(outputs indexed by ``i`` only are revisited across the sequential
+``j`` steps).
 
 Memory layout is (8, 128)-tile aligned: every block's trailing (lane)
 dimension is a multiple of 128 — the anchor-side x/y/mask are packed
 into one ``(TM, 128)`` block (columns 0..2), the running top-D state
-and outputs are ``(TM, 128)`` with the first ``D`` lanes meaningful,
-and candidate tiles are ``(1, TN)`` with ``TN`` a multiple of 128.
+and outputs span ``ceil((D+1)/128)`` lane blocks (first ``D`` lanes
+meaningful, the adjacency count in lane ``D``), and candidate tiles
+are ``(1, TN)`` with ``TN`` a multiple of 128.
 (The original layout used (TM, 1)/(TM, D) blocks, which relied on
 implicit lane padding the TPU lowering does not guarantee — ADVICE
 round 1.)
@@ -48,6 +50,10 @@ from jax.experimental import pallas as pl
 
 NEG = -1.0  # sentinel value for empty top-D slots (any IoU is >= 0)
 LANE = 128  # TPU lane width; all trailing block dims align to this
+# Fail-fast ceiling for direct callers: the merge is d unrolled
+# passes, so a runaway d buys minutes of trace/compile, not a better
+# kernel.  enumerate_cliques applies its own (lower) escalation cap.
+MAX_D = 1024
 
 
 def _neighbor_kernel(
@@ -59,6 +65,7 @@ def _neighbor_kernel(
     sa = size_ref[0]
     sb = size_ref[1]
     tm = tv_ref.shape[0]
+    w = tv_ref.shape[1]  # state width: ceil((d+1)/LANE) lane blocks
 
     @pl.when(j == 0)
     def _init():
@@ -69,7 +76,7 @@ def _neighbor_kernel(
             [
                 jnp.full((tm, d), m_total, ti_ref.dtype),
                 jnp.zeros((tm, 1), ti_ref.dtype),
-                jnp.full((tm, LANE - d - 1), m_total, ti_ref.dtype),
+                jnp.full((tm, w - d - 1), m_total, ti_ref.dtype),
             ],
             axis=1,
         )
@@ -132,9 +139,9 @@ def _neighbor_kernel(
         new_v.append(row_max)
         new_i.append(picked_i)
         work_v = jnp.where(sel, NEG, work_v)
-    new_v.append(jnp.full((tm, LANE - d), NEG, tv_ref.dtype))
+    new_v.append(jnp.full((tm, w - d), NEG, tv_ref.dtype))
     new_i.append(cnt)  # the count rides in lane d
-    new_i.append(jnp.full((tm, LANE - d - 1), m_total, jnp.int32))
+    new_i.append(jnp.full((tm, w - d - 1), m_total, jnp.int32))
     tv_ref[:] = jnp.concatenate(new_v, axis=1)
     ti_ref[:] = jnp.concatenate(new_i, axis=1)
 
@@ -174,11 +181,18 @@ def pallas_topk_neighbors(
     """
     from jax.experimental.pallas import tpu as pltpu
 
-    if d >= LANE:
-        # the top-D state and the adjacency count share one 128-lane
-        # block; callers needing d >= 128 use the XLA matrix path
-        # (enumerate_cliques falls back automatically)
-        raise ValueError(f"d={d} needs the XLA path (limit {LANE - 1})")
+    # State width: as many 128-lane blocks as d+1 (top-D + the
+    # adjacency count in lane d) needs.  d < 128 keeps the original
+    # single-block layout; larger d widens the revisited output block
+    # instead of falling back to the XLA matrix path.  The merge is d
+    # unrolled passes, so compile time and VPU work grow with d —
+    # enumerate_cliques caps its escalation use accordingly.
+    if d > MAX_D:
+        raise ValueError(
+            f"d={d} exceeds MAX_D={MAX_D}: the merge unrolls d "
+            "select-max passes; use the XLA matrix path instead"
+        )
+    w = -(-(d + 1) // LANE) * LANE
     n, m = xy_a.shape[0], xy_b.shape[0]
     if n == 0 or m == 0:
         return (
@@ -235,12 +249,12 @@ def pallas_topk_neighbors(
             pl.BlockSpec((1, tn), lambda i, j: (0, j)),
         ],
         out_specs=[
-            pl.BlockSpec((tm, LANE), lambda i, j: (i, 0)),
-            pl.BlockSpec((tm, LANE), lambda i, j: (i, 0)),
+            pl.BlockSpec((tm, w), lambda i, j: (i, 0)),
+            pl.BlockSpec((tm, w), lambda i, j: (i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((np_, LANE), xy_a.dtype),
-            jax.ShapeDtypeStruct((np_, LANE), jnp.int32),
+            jax.ShapeDtypeStruct((np_, w), xy_a.dtype),
+            jax.ShapeDtypeStruct((np_, w), jnp.int32),
         ],
         interpret=interpret,
     )(sizes, a_pack, bx, by, bm)

@@ -228,9 +228,39 @@ def test_empty_candidate_set_early_return():
     assert (np.asarray(adj) == 0).all()
 
 
-def test_large_d_falls_back_to_xla_path():
-    """The escalation loop can push D past the 128-lane Pallas state;
-    enumerate_cliques must fall back to the matrix path, not crash."""
+@pytest.mark.parametrize("d", [128, 200])
+def test_multi_block_top_d_state_matches_dense(d):
+    """d >= 128 spans multiple 128-lane state blocks (the old layout's
+    hard limit); values, counts, and index validity must still match
+    the dense matrix path exactly."""
+    rng = np.random.default_rng(d)
+    xa, ma, xb, mb = _sets(rng, 96, 320, extent=900.0)
+    tv, ti, cnt = pallas_topk_neighbors(
+        xa, ma, xb, mb, BOX, BOX, d=d, tile_m=32, tile_n=128,
+        interpret=True,
+    )
+    assert tv.shape == (96, d) and ti.shape == (96, d)
+    ref = pairwise_iou_matrix(xa, ma, xb, mb, BOX)
+    rv, _ = jax.lax.top_k(ref, d)
+    np.testing.assert_allclose(
+        np.where(np.asarray(tv) < 0, 0.0, np.asarray(tv)),
+        np.asarray(rv),
+        atol=1e-6,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(cnt), np.sum(np.asarray(ref) > 0.3, axis=1)
+    )
+    refn, tvn, tin = np.asarray(ref), np.asarray(tv), np.asarray(ti)
+    for i in range(0, 96, 7):
+        for v, ix in zip(tvn[i], tin[i]):
+            if v > 1e-6:
+                assert ix < 320
+                np.testing.assert_allclose(refn[i, ix], v, atol=1e-6)
+
+
+def test_d128_stays_on_pallas_and_matches():
+    """D=128 (the old fallback point) now runs the widened kernel; the
+    clique set must equal the matrix path's."""
     rng = np.random.default_rng(5)
     n = 160
     xy = jnp.asarray(rng.uniform(0, 800, size=(2, n, 2)), jnp.float32)
@@ -241,5 +271,22 @@ def test_large_d_falls_back_to_xla_path():
     )
     ref = enumerate_cliques(
         xy, conf, mask, BOX, max_neighbors=128, use_pallas=False
+    )
+    assert int(cs.num_valid) == int(ref.num_valid)
+
+
+def test_past_cap_d_falls_back_to_xla_with_warning():
+    """Past _PALLAS_MAX_D the matrix path takes over — loudly."""
+    rng = np.random.default_rng(6)
+    n = 300
+    xy = jnp.asarray(rng.uniform(0, 800, size=(2, n, 2)), jnp.float32)
+    conf = jnp.ones((2, n), jnp.float32)
+    mask = jnp.ones((2, n), bool)
+    with pytest.warns(UserWarning, match="exceeds the Pallas"):
+        cs = enumerate_cliques(
+            xy, conf, mask, BOX, max_neighbors=257, use_pallas=True
+        )
+    ref = enumerate_cliques(
+        xy, conf, mask, BOX, max_neighbors=257, use_pallas=False
     )
     assert int(cs.num_valid) == int(ref.num_valid)
