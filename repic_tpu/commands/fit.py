@@ -101,6 +101,12 @@ def add_arguments(parser) -> None:
         help="filter pyramid (cnn.ARCHS); 'deep' is the "
         "reference-parity DeepPicker stack",
     )
+    parser.add_argument(
+        "--bf16",
+        action="store_true",
+        help="bfloat16 conv/matmul compute (MXU-native, half the HBM "
+        "traffic); parameters, loss, and optimizer state stay float32",
+    )
 
 
 def main(args) -> None:
@@ -204,6 +210,7 @@ def main(args) -> None:
         batch_size=args.batch_size,
         max_epochs=args.max_epochs,
         seed=args.seed,
+        compute_dtype="bfloat16" if args.bf16 else "float32",
     )
     result = fit(
         train_data,

@@ -23,6 +23,8 @@ the TPU-fast replacement for the reference's dense
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
 import jax.numpy as jnp
 
@@ -78,15 +80,36 @@ def arch_kwargs(arch: str) -> dict:
     return ARCHS[arch]
 
 
+def compute_dtype(name: str):
+    """Map a CLI-friendly dtype name to the computation dtype."""
+    table = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+    if name not in table:
+        raise ValueError(
+            f"unknown compute dtype {name!r} (have {sorted(table)})"
+        )
+    return table[name]
+
+
 class Backbone(nn.Module):
-    """The four VALID conv+pool blocks shared by both heads."""
+    """The four VALID conv+pool blocks shared by both heads.
+
+    ``dtype`` is the computation dtype (TPU-native: ``jnp.bfloat16``
+    runs the convs on the MXU at half the HBM traffic); parameters
+    are always stored float32 (flax's ``param_dtype`` default), the
+    standard master-weights mixed-precision recipe.
+    """
 
     conv_spec: tuple = CONV_SPEC
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
+        x = x.astype(self.dtype)
         for i, (k, f) in enumerate(self.conv_spec):
-            x = nn.Conv(f, (k, k), padding="VALID", name=f"conv{i + 1}")(x)
+            x = nn.Conv(
+                f, (k, k), padding="VALID", dtype=self.dtype,
+                name=f"conv{i + 1}",
+            )(x)
             x = nn.relu(x)
             x = nn.max_pool(x, (2, 2), strides=(2, 2), padding="VALID")
         return x
@@ -102,15 +125,21 @@ class PickerCNN(nn.Module):
     num_class: int = 2
     conv_spec: tuple = CONV_SPEC
     fc_width: int = FC_WIDTH
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False):
-        x = Backbone(self.conv_spec, name="backbone")(x)
+        x = Backbone(self.conv_spec, self.dtype, name="backbone")(x)
         x = x.reshape(x.shape[0], -1)
         if train:
             x = nn.Dropout(rate=0.5, deterministic=False)(x)
-        x = nn.relu(nn.Dense(self.fc_width, name="fc1")(x))
-        return nn.Dense(self.num_class, name="fc2")(x)
+        x = nn.relu(
+            nn.Dense(self.fc_width, dtype=self.dtype, name="fc1")(x)
+        )
+        x = nn.Dense(self.num_class, dtype=self.dtype, name="fc2")(x)
+        # logits always float32: softmax/cross-entropy stay stable
+        # regardless of the backbone compute dtype
+        return x.astype(jnp.float32)
 
 
 class PickerFCN(nn.Module):
@@ -126,20 +155,25 @@ class PickerFCN(nn.Module):
     num_class: int = 2
     conv_spec: tuple = CONV_SPEC
     fc_width: int = FC_WIDTH
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray):
-        x = Backbone(self.conv_spec, name="backbone")(x)
+        x = Backbone(self.conv_spec, self.dtype, name="backbone")(x)
         # fc1 as a 2x2 VALID conv over the feature map == Dense on the
         # flattened 2x2xC window at each output position.
         x = nn.Conv(
             self.fc_width,
             (FEAT_SPATIAL, FEAT_SPATIAL),
             padding="VALID",
+            dtype=self.dtype,
             name="fc1_conv",
         )(x)
         x = nn.relu(x)
-        return nn.Conv(self.num_class, (1, 1), name="fc2_conv")(x)
+        x = nn.Conv(
+            self.num_class, (1, 1), dtype=self.dtype, name="fc2_conv"
+        )(x)
+        return x.astype(jnp.float32)
 
 
 def fc_params_as_conv(params: dict) -> dict:

@@ -38,6 +38,7 @@ from repic_tpu.models.cnn import (
     PickerCNN,
     PickerFCN,
     arch_kwargs,
+    compute_dtype,
     fc_params_as_conv,
 )
 from repic_tpu.models import preprocess as pp
@@ -55,11 +56,13 @@ def score_grid_shape(shape, patch_size: int, step: int = STEP_SIZE):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("patch_size", "step", "norm", "arch")
+    jax.jit,
+    static_argnames=("patch_size", "step", "norm", "arch", "dtype"),
 )
 def score_micrograph_patches(
     params, img, *, patch_size: int, step: int = STEP_SIZE,
     norm: str = "reference", arch: str = "deep",
+    dtype: str = "float32",
 ):
     """Dense sliding-window scoring via the patch classifier.
 
@@ -79,7 +82,7 @@ def score_micrograph_patches(
     H, W = img.shape
     out_h, out_w = score_grid_shape(img.shape, patch_size, step)
     row_chunk = min(ROW_CHUNK, out_h)
-    model = PickerCNN(**arch_kwargs(arch))
+    model = PickerCNN(**arch_kwargs(arch), dtype=compute_dtype(dtype))
 
     col_starts = jnp.arange(out_w) * step
     col_idx = col_starts[:, None] + jnp.arange(patch_size)[None, :]
@@ -119,11 +122,11 @@ def score_micrograph_patches(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("patch_size", "step", "arch")
+    jax.jit, static_argnames=("patch_size", "step", "arch", "dtype")
 )
 def score_micrograph_fcn(
     fcn_params, img, *, patch_size: int, step: int = STEP_SIZE,
-    arch: str = "deep",
+    arch: str = "deep", dtype: str = "float32",
 ):
     """Fully-convolutional scoring with stride-``step`` shift filling.
 
@@ -133,7 +136,7 @@ def score_micrograph_fcn(
     Patches are resized from ``patch_size`` to 64 implicitly by
     scaling the image once (global normalization).
     """
-    model = PickerFCN(**arch_kwargs(arch))
+    model = PickerFCN(**arch_kwargs(arch), dtype=compute_dtype(dtype))
     # Resize the whole micrograph so each patch_size window maps to a
     # 64x64 window; then the FCN scores all windows at once.
     H, W = img.shape
@@ -281,6 +284,7 @@ def pick_micrograph(
     norm: str = "reference",
     step: int = STEP_SIZE,
     arch: str = "deep",
+    dtype: str = "float32",
 ):
     """Full picking pass over one raw micrograph.
 
@@ -294,7 +298,7 @@ def pick_micrograph(
     if mode == "fcn":
         smap = score_micrograph_fcn(
             fc_params_as_conv(params), img, patch_size=patch_size,
-            step=step, arch=arch,
+            step=step, arch=arch, dtype=dtype,
         )
         # FCN scoring works on the rescaled grid; its effective step
         # on the binned image is patch_size/64 * round(step*64/patch).
@@ -303,7 +307,7 @@ def pick_micrograph(
     else:
         smap = score_micrograph_patches(
             params, img, patch_size=patch_size, step=step, norm=norm,
-            arch=arch,
+            arch=arch, dtype=dtype,
         )
         eff_step = step
     peaks = peak_detection(np.asarray(smap), max(window, 1))

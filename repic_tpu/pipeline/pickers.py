@@ -49,6 +49,10 @@ class BuiltinPicker:
     threshold: float = 0.0  # run_deep.sh:26 applies 0.0
     mode: str = "patch"
     arch: str = "deep"  # cnn.ARCHS filter pyramid
+    # "bfloat16" runs scoring AND training compute on the MXU at half
+    # the HBM traffic (params/checkpoints stay float32) — the bulk
+    # whole-dataset picking rounds are where the traffic saving lands
+    compute_dtype: str = "float32"
 
     def predict(self, mrc_dir: str, out_box_dir: str) -> int:
         """Pick every micrograph; returns total particles written."""
@@ -81,6 +85,7 @@ class BuiltinPicker:
                 mode=self.mode,
                 norm=meta.get("patch_norm", "reference"),
                 arch=meta.get("arch", self.arch),
+                dtype=self.compute_dtype,
             )
             coords = coords[coords[:, 2] >= self.threshold]
             stem = os.path.splitext(os.path.basename(path))[0]
@@ -135,6 +140,7 @@ class BuiltinPicker:
                 max_epochs=self.max_epochs,
                 seed=self.seed,
                 verbose=False,
+                compute_dtype=self.compute_dtype,
             ),
             init_params=init_params,
             arch=self.arch,
