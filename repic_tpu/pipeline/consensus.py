@@ -355,6 +355,35 @@ def _probe_reduce(max_adjacency, num_cliques, max_cell_count, max_partial):
     ).astype(jnp.int32)
 
 
+def escalate_capacities(probes, d, cap, cell_cap, pcap, *, has_grid):
+    """The one escalation policy for all consensus paths.
+
+    ``probes`` is the fetched ``_probe_reduce`` vector
+    ``(max_adjacency, num_cliques, max_cell, max_partial)``.  Each
+    capacity escalates straight to the observed requirement (each
+    distinct config is a fresh XLA compile — don't ladder by 2x).
+    Returns ``(d, cap, cell_cap, pcap, retry)``.
+    """
+    max_adj, n_cliques, max_cell, max_part = (int(v) for v in probes)
+    retry = False
+    if has_grid and max_cell > cell_cap:
+        cell_cap = _next_pow2(max_cell)
+        retry = True
+    if max_adj > d:
+        d = _next_pow2(max_adj)
+        retry = True
+    if n_cliques > cap:
+        cap = _next_pow2(n_cliques)
+        retry = True
+    if max_part > pcap:
+        # partial tuples live in their own (pcap, K) buffers, so
+        # escalating them does not inflate the final clique buffers /
+        # solver pack the way escalating `cap` would
+        pcap = _next_pow2(max_part)
+        retry = True
+    return d, cap, cell_cap, pcap, retry
+
+
 def run_consensus_batch(
     batch: PaddedBatch,
     box_size,
@@ -456,36 +485,18 @@ def run_consensus_batch(
         if mesh is not None:
             xy, conf, mask = shard_over_micrographs(mesh, xy, conf, mask)
         res = fn(xy, conf, mask, box_arg)
-        # Escalate straight to the observed requirement (each distinct
-        # capacity config is a fresh XLA compile — don't ladder by 2x).
-        # The three probes are reduced on device and fetched in ONE
+        # The four probes are reduced on device and fetched in ONE
         # transfer: per-scalar fetches each pay a full host<->device
         # round trip (expensive over a tunneled TPU).
-        max_adj, n_cliques, max_cell, max_part = (
-            int(v) for v in np.asarray(
-                _probe_reduce(
-                    res.max_adjacency, res.num_cliques,
-                    res.max_cell_count, res.max_partial,
-                )
+        probes = np.asarray(
+            _probe_reduce(
+                res.max_adjacency, res.num_cliques,
+                res.max_cell_count, res.max_partial,
             )
         )
-        retry = False
-        if grid is not None:
-            if max_cell > cell_cap:
-                cell_cap = _next_pow2(max_cell)
-                retry = True
-        if max_adj > d:
-            d = _next_pow2(max_adj)
-            retry = True
-        if n_cliques > cap:
-            cap = _next_pow2(n_cliques)
-            retry = True
-        if max_part > pcap:
-            # partial tuples live in their own (pcap, K) buffers, so
-            # escalating them does not inflate the final clique
-            # buffers / solver pack the way escalating `cap` would
-            pcap = _next_pow2(max_part)
-            retry = True
+        d, cap, cell_cap, pcap, retry = escalate_capacities(
+            probes, d, cap, cell_cap, pcap, has_grid=grid is not None
+        )
         if retry:
             continue
         _LAST_GOOD_CONFIG[cfg_key] = (d, cap, cell_cap, pcap)

@@ -1,0 +1,268 @@
+"""Particle-axis sharded consensus for one giant micrograph.
+
+The batched path scales across micrographs (data parallelism over the
+mesh); this module scales *within* one micrograph — the framework's
+sequence/context parallelism.  A dense field is a 2-D "sequence" of
+particles whose interactions are spatially local (IoU > 0 needs
+|dx| < box), so the micrograph splits into device-owned x-stripes with
+a one-box-size halo, the spatial analog of ring attention's
+neighbor-shard exchange for long sequences:
+
+* **Shard**: anchors (picker 0) are partitioned into ``S`` stripes by
+  sorted-x rank (balanced counts, every anchor owned by exactly one
+  stripe).  Each stripe's candidate window for pickers 1..K-1 extends
+  one ``reach`` ( = max box size) past its anchors' x-span — every
+  edge and every clique member an owned anchor can touch lies inside
+  the window, because all members of a clique overlap the anchor.
+* **Compute**: the stripes become a batch of pseudo-micrographs run
+  through the existing enumeration machinery (dense or bucketed),
+  sharded over the device mesh exactly like the micrograph axis — one
+  XLA program, no per-stripe Python.  Anchor exclusivity means no
+  clique is produced twice.
+* **Combine**: stripe-local member indices map to global particle ids
+  through per-stripe gather tables, the per-stripe clique sets
+  concatenate into one global packing problem, and ONE solver pass
+  picks the consensus — packing constraints that cross a stripe
+  boundary (a halo candidate claimed by cliques of two neighboring
+  stripes) are resolved globally, where solving is cheap: the clique
+  set is thousands of rows regardless of how many devices enumerated
+  it.
+
+Same capacity-escalation idiom as ``run_consensus_batch``: static
+shapes, device-side overflow probes, host-side escalation re-compile.
+
+Reference hot loop being replaced: the per-micrograph Python pipeline
+(repic/commands/get_cliques.py:59-69,107-150) has no intra-micrograph
+scaling story at all — one huge micrograph is one Python loop.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from repic_tpu.ops.cliques import (
+    DEFAULT_THRESHOLD,
+    compact_cliques,
+    enumerate_cliques,
+    enumerate_cliques_bucketed,
+)
+from repic_tpu.ops.solver import solve_greedy, solve_lp_rounding
+from repic_tpu.parallel.batching import bucket_size
+from repic_tpu.parallel.mesh import MICROGRAPH_AXIS, consensus_mesh
+
+
+def build_stripes(sets, n_stripes: int, reach: float):
+    """Host-side stripe construction for one micrograph.
+
+    Args:
+        sets: one :class:`~repic_tpu.utils.box_io.BoxSet` per picker.
+        n_stripes: stripe (shard) count ``S``.
+        reach: halo width in pixels — the largest box size; any
+            overlapping pair is within ``reach`` in x.
+
+    Returns:
+        ``(xy, conf, mask, l2g)`` with shapes ``(S, K, nb, 2)`` /
+        ``(S, K, nb)`` / ``(S, K, nb)`` / ``(S, K, nb)`` where ``nb``
+        is the power-of-two stripe capacity; ``l2g[s, p, j]`` is the
+        global particle index of stripe-local particle ``j`` (0 in
+        padded slots — mask gates validity).
+    """
+    k = len(sets)
+    xs0 = sets[0].xy[:, 0]
+    order = np.argsort(xs0, kind="stable")
+    splits = np.array_split(order, n_stripes)
+
+    # per-stripe global index lists, picker 0 = owned anchors only
+    stripe_idx: list[list[np.ndarray]] = []
+    for anchors in splits:
+        if len(anchors):
+            lo = float(xs0[anchors].min()) - reach
+            hi = float(xs0[anchors].max()) + reach
+        else:
+            lo, hi = 0.0, -1.0  # empty window
+        per_picker = [anchors.astype(np.int64)]
+        for p in range(1, k):
+            xp = sets[p].xy[:, 0]
+            per_picker.append(
+                np.where((xp >= lo) & (xp <= hi))[0]
+            )
+        stripe_idx.append(per_picker)
+
+    nb = bucket_size(
+        max(
+            (len(idx) for per in stripe_idx for idx in per),
+            default=1,
+        )
+    )
+    S = n_stripes
+    xy = np.zeros((S, k, nb, 2), np.float32)
+    conf = np.zeros((S, k, nb), np.float32)
+    mask = np.zeros((S, k, nb), bool)
+    l2g = np.zeros((S, k, nb), np.int32)
+    for s, per in enumerate(stripe_idx):
+        for p, idx in enumerate(per):
+            n = len(idx)
+            xy[s, p, :n] = sets[p].xy[idx]
+            conf[s, p, :n] = sets[p].conf[idx]
+            mask[s, p, :n] = True
+            l2g[s, p, :n] = idx
+    return xy, conf, mask, l2g
+
+
+@lru_cache(maxsize=32)
+def _make_striped_enum(
+    threshold, d, cap, mesh, grid, cell_cap, pcap
+):
+    """Jitted stripe-batched enumeration (no solver — that's global)."""
+
+    def enum_one(xy, conf, mask, box_arg):
+        if grid is not None:
+            cs = enumerate_cliques_bucketed(
+                xy, conf, mask, box_arg,
+                threshold=threshold,
+                max_neighbors=d,
+                grid=grid,
+                cell_capacity=cell_cap,
+                clique_capacity=cap,
+                partial_capacity=pcap,
+            )
+        else:
+            cs = enumerate_cliques(
+                xy, conf, mask, box_arg,
+                threshold=threshold,
+                max_neighbors=d,
+                clique_capacity=cap,
+                partial_capacity=pcap,
+            )
+        return compact_cliques(cs, cap)
+
+    batched = jax.vmap(enum_one, in_axes=(0, 0, 0, None))
+    if mesh is None:
+        return jax.jit(batched)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shard = NamedSharding(mesh, P(MICROGRAPH_AXIS))
+    return jax.jit(
+        batched,
+        in_shardings=(shard, shard, shard, None),
+        out_shardings=shard,
+    )
+
+
+def run_consensus_giant(
+    sets,
+    box_size,
+    *,
+    n_stripes: int | None = None,
+    threshold: float = DEFAULT_THRESHOLD,
+    max_neighbors: int = 16,
+    use_mesh: bool = True,
+    spatial: bool | None = None,
+    solver: str = "greedy",
+) -> dict:
+    """Consensus for ONE giant micrograph, sharded over the mesh.
+
+    Returns a dict with the flattened global clique arrays:
+    ``member_idx`` (C, K) global per-picker particle indices, ``w``,
+    ``confidence``, ``rep_xy``, ``rep_slot``, ``valid``, ``picked``,
+    plus ``num_cliques`` and the stripe geometry.  ``picked & valid``
+    selects the consensus cliques; member indices refer to the
+    original (unsorted) ``sets`` order.
+    """
+    from repic_tpu.pipeline.consensus import SPATIAL_THRESHOLD
+
+    k = len(sets)
+    mesh = consensus_mesh() if use_mesh else None
+    if n_stripes is None:
+        n_stripes = len(mesh.devices.flatten()) if mesh else 1
+    if mesh is not None:
+        n_dev = len(mesh.devices.flatten())
+        n_stripes = max(-(-n_stripes // n_dev) * n_dev, n_dev)
+
+    sizes = np.asarray(box_size, np.float32)
+    reach = float(sizes.max())
+    box_arg = (
+        jnp.asarray(sizes) if sizes.ndim else float(box_size)
+    )
+    xy, conf, mask, l2g = build_stripes(sets, n_stripes, reach)
+
+    n_max = max(s.n for s in sets)
+    if spatial is None:
+        spatial = xy.shape[2] > SPATIAL_THRESHOLD
+    grid = None
+    cell_cap = 64
+    if spatial:
+        from repic_tpu.ops.spatial import grid_size
+
+        extent = float(
+            max(s.xy.max() if s.n else 0.0 for s in sets)
+        ) + reach
+        grid = grid_size(extent, reach)
+
+    from repic_tpu.pipeline.consensus import (
+        _probe_reduce,
+        escalate_capacities,
+    )
+
+    d = max_neighbors
+    cap = max(4 * xy.shape[2], 1024)
+    pcap = cap
+    while True:
+        fn = _make_striped_enum(
+            threshold, d, cap, mesh, grid, cell_cap, pcap
+        )
+        cs = fn(xy, conf, mask, box_arg)
+        probes = np.asarray(
+            _probe_reduce(
+                cs.max_adjacency, cs.num_valid,
+                cs.max_cell_count, jnp.asarray(cs.max_partial),
+            )
+        )
+        d, cap, cell_cap, pcap, retry = escalate_capacities(
+            probes, d, cap, cell_cap, pcap, has_grid=grid is not None
+        )
+        if not retry:
+            break
+
+    # Stripe-local -> global member ids (vectorized gather), flatten
+    # stripes, and solve the ONE global packing problem.
+    member = np.asarray(cs.member_idx)      # (S, cap, K)
+    valid = np.asarray(cs.valid).reshape(-1)
+    l2g_np = np.asarray(l2g)                # (S, K, nb)
+    S, cap_out, _ = member.shape
+    glob = np.empty((S, cap_out, k), np.int32)
+    for p in range(k):
+        glob[..., p] = np.take_along_axis(
+            l2g_np[:, p, :], member[..., p], axis=1
+        )
+    glob = glob.reshape(-1, k)
+    w = np.asarray(cs.w).reshape(-1)
+    vid = jnp.asarray(glob) + (
+        jnp.arange(k, dtype=jnp.int32) * n_max
+    )[None, :]
+    vid = jnp.where(jnp.asarray(valid)[:, None], vid, 0)
+    solve = solve_lp_rounding if solver == "lp" else solve_greedy
+    picked = np.asarray(
+        solve(
+            vid,
+            jnp.asarray(w),
+            jnp.asarray(valid),
+            k * n_max,
+        )
+    )
+    return {
+        "member_idx": glob,
+        "w": w,
+        "confidence": np.asarray(cs.confidence).reshape(-1),
+        "rep_xy": np.asarray(cs.rep_xy).reshape(-1, 2),
+        "rep_slot": np.asarray(cs.rep_slot).reshape(-1),
+        "valid": valid,
+        "picked": picked & valid,
+        "num_cliques": int(np.asarray(cs.num_valid).sum()),
+        "n_stripes": n_stripes,
+        "stripe_capacity": xy.shape[2],
+    }

@@ -1,0 +1,141 @@
+"""Particle-axis (stripe) sharded consensus vs the single-device path.
+
+The giant-micrograph path shards ONE micrograph's particles into
+device-owned x-stripes with a box-size halo (pipeline/giant.py — the
+framework's sequence-parallel analog).  Gates:
+
+* the stripe-sharded clique set and the single-device clique set are
+  IDENTICAL (membership and weights) on both the dense and bucketed
+  enumeration paths, over the 8-device CPU mesh;
+* the globally-solved consensus equals the single-device consensus
+  (same picked member sets — the global solve is what makes
+  cross-stripe halo conflicts safe);
+* anchors are never double-owned and halo construction misses no
+  boundary clique (stripe count sweep).
+"""
+
+import numpy as np
+import pytest
+
+from repic_tpu.parallel.batching import pad_batch
+from repic_tpu.pipeline.consensus import run_consensus_batch
+from repic_tpu.pipeline.giant import build_stripes, run_consensus_giant
+from repic_tpu.utils.box_io import BoxSet
+
+BOX = 180.0
+
+
+def _field(n, k=3, seed=0, spacing=150.0, jitter=12.0):
+    """Cluster-structured dense field, one BoxSet per picker."""
+    rng = np.random.default_rng(seed)
+    side = int(np.ceil(np.sqrt(n)))
+    gx, gy = np.meshgrid(np.arange(side), np.arange(side))
+    base = (
+        np.stack([gx, gy], -1).reshape(-1, 2)[:n].astype(np.float32)
+        * spacing
+        + spacing
+    )
+    sets = []
+    for _ in range(k):
+        xy = base + rng.normal(0, jitter, base.shape).astype(np.float32)
+        conf = rng.uniform(0.05, 1.0, size=n).astype(np.float32)
+        wh = np.full((n, 2), BOX, np.float32)
+        sets.append(BoxSet(xy=xy, conf=conf, wh=wh))
+    return sets
+
+
+def _single_device_result(sets, spatial):
+    batch = pad_batch([("m0", sets)], pad_micrographs_to=1)
+    res = run_consensus_batch(
+        batch, BOX, use_mesh=False, spatial=spatial
+    )
+    valid = np.asarray(res.valid[0])
+    return (
+        np.asarray(res.member_idx[0])[valid],
+        np.asarray(res.w[0])[valid],
+        np.asarray(res.picked[0])[valid],
+    )
+
+
+def _keys(member, k):
+    """One hashable identity per clique row."""
+    return [
+        tuple((p, int(row[p])) for p in range(k)) for row in member
+    ]
+
+
+def _clique_keys(member, k):
+    return set(_keys(member, k))
+
+
+@pytest.mark.parametrize(
+    "n,spatial", [(1200, False), (5200, True)],
+    ids=["dense", "bucketed"],
+)
+def test_striped_equals_single_device(n, spatial):
+    sets = _field(n)
+    k = len(sets)
+    giant = run_consensus_giant(
+        sets, BOX, use_mesh=True, spatial=spatial
+    )
+    assert giant["n_stripes"] >= 8  # really sharded over the mesh
+
+    g_valid = giant["valid"]
+    g_member = giant["member_idx"][g_valid]
+    g_w = dict(zip(_keys(g_member, k), giant["w"][g_valid]))
+
+    s_member, s_w, s_picked = _single_device_result(sets, spatial)
+    want = _clique_keys(s_member, k)
+    got = _clique_keys(g_member, k)
+    assert got == want  # identical clique sets across stripes
+
+    for key, wv in zip(_keys(s_member, k), s_w):
+        np.testing.assert_allclose(g_w[key], wv, atol=1e-5)
+
+    # consensus equality: same picked member sets
+    g_picked_keys = _clique_keys(
+        giant["member_idx"][giant["picked"]], k
+    )
+    s_picked_keys = _clique_keys(s_member[s_picked], k)
+    assert g_picked_keys == s_picked_keys
+
+
+def test_anchors_owned_exactly_once():
+    sets = _field(900, seed=3)
+    xy, conf, mask, l2g = build_stripes(sets, 8, BOX)
+    owned = l2g[:, 0, :][mask[:, 0, :]]
+    assert len(owned) == sets[0].n
+    assert len(np.unique(owned)) == sets[0].n
+
+
+@pytest.mark.parametrize("n_stripes", [1, 3, 8, 16])
+def test_stripe_count_sweep_preserves_cliques(n_stripes):
+    """Any stripe count yields the same clique set — boundary cliques
+    are never lost to a short halo, never duplicated across owners."""
+    sets = _field(800, seed=5)
+    k = len(sets)
+    base = run_consensus_giant(
+        sets, BOX, n_stripes=1, use_mesh=False, spatial=False
+    )
+    want = _clique_keys(base["member_idx"][base["valid"]], k)
+    got_res = run_consensus_giant(
+        sets, BOX, n_stripes=n_stripes, use_mesh=False, spatial=False
+    )
+    got = _clique_keys(got_res["member_idx"][got_res["valid"]], k)
+    assert got == want
+
+
+def test_empty_and_tiny_stripes():
+    """More stripes than anchors: the extra stripes are empty and the
+    result still matches."""
+    sets = _field(12, seed=9)
+    k = len(sets)
+    res = run_consensus_giant(
+        sets, BOX, n_stripes=16, use_mesh=False, spatial=False
+    )
+    base = run_consensus_giant(
+        sets, BOX, n_stripes=1, use_mesh=False, spatial=False
+    )
+    assert _clique_keys(
+        res["member_idx"][res["valid"]], k
+    ) == _clique_keys(base["member_idx"][base["valid"]], k)
