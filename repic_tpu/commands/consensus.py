@@ -75,6 +75,15 @@ def add_arguments(parser):
         "ignored with a warning when the spatial/bucketed search "
         "is selected (--spatial on, or auto above 4096 particles)",
     )
+    parser.add_argument(
+        "--stripes",
+        type=int,
+        metavar="S",
+        help="particle-axis sharding: split EACH micrograph into S "
+        "device-owned x-stripes with a box-size halo and shard the "
+        "stripes over the mesh (sequence-parallel analog for giant "
+        "micrographs; output is identical to the unsharded path)",
+    )
 
 
 def main(args):
@@ -96,6 +105,7 @@ def main(args):
             use_pallas=args.pallas,
             multi_out=args.multi_out,
             get_cc=args.get_cc,
+            stripes=args.stripes,
         )
     print(json.dumps(stats, default=str, indent=2))
 

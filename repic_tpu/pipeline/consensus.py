@@ -732,6 +732,7 @@ def run_consensus_dir(
     use_pallas: bool = False,
     multi_out: bool = False,
     get_cc: bool = False,
+    stripes: int | None = None,
 ) -> dict:
     """End-to-end: read picker BOX dirs, consensus, write BOX files.
 
@@ -739,6 +740,11 @@ def run_consensus_dir(
     surface on this fused path (per-picker TSVs / largest-CC filter),
     equal to the two-phase pipeline's output for the same flags —
     see :func:`write_consensus_tables`.
+
+    ``stripes`` switches to the particle-axis sharded path: each
+    micrograph splits into that many device-owned x-stripes sharded
+    over the mesh (:mod:`repic_tpu.pipeline.giant` — the giant-
+    micrograph sequence-parallel analog; identical output).
 
     Directory layout matches the reference (``in_dir/<picker>/*.box``,
     reference: get_cliques.py:81-105); micrographs missing from any
@@ -813,6 +819,70 @@ def run_consensus_dir(
 
     timer.stages.append(("load", time.time() - t0))
     n_dev = len(jax.devices()) if use_mesh else 1
+
+    if stripes is not None:
+        if multi_out or get_cc:
+            raise ValueError(
+                "--stripes composes with the plain BOX output only "
+                "(use the batched path for --multi_out/--get_cc)"
+            )
+        if stripes < 1:
+            raise ValueError(f"--stripes must be >= 1, got {stripes}")
+        if use_pallas:
+            import warnings
+
+            warnings.warn(
+                "--pallas applies to the batched dense path only; "
+                "the striped (--stripes) path uses the bucketed/"
+                "dense XLA kernels",
+                stacklevel=2,
+            )
+        from repic_tpu.pipeline.giant import run_consensus_giant
+
+        compute_s = 0.0
+        write_s = 0.0
+        counts = {}
+        num_cliques = 0
+        actual_stripes = stripes
+        for name, sets in loaded:
+            t1 = time.time()
+            giant = run_consensus_giant(
+                sets,
+                box_size,
+                n_stripes=stripes,
+                threshold=threshold,
+                max_neighbors=max_neighbors,
+                use_mesh=use_mesh,
+                spatial=spatial,
+                solver=solver,
+            )
+            compute_s += time.time() - t1
+            actual_stripes = giant["n_stripes"]
+            t2 = time.time()
+            sel = giant["picked"]
+            counts[name] = _write_box_file(
+                os.path.join(out_dir, name + ".box"),
+                giant["rep_xy"][sel],
+                giant["confidence"][sel],
+                giant["rep_slot"][sel],
+                box_size,
+                num_particles,
+            )
+            write_s += time.time() - t2
+            num_cliques += giant["num_cliques"]
+        timer.stages.append(("compute", compute_s))
+        timer.stages.append(("write", write_s))
+        timer.write_tsv(out_dir, "consensus_runtime.tsv")
+        stats.update(
+            compute_s=compute_s,
+            write_s=write_s,
+            total_s=time.time() - t0,
+            particle_counts=counts,
+            num_cliques=num_cliques,
+            stripes=actual_stripes,
+        )
+        return stats
+
     want_tables = multi_out or get_cc
     cc_fn = None
     if get_cc:
