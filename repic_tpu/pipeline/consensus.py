@@ -763,6 +763,27 @@ def run_consensus_dir(
 
     from repic_tpu.utils.tracing import StageTimer, annotate
 
+    # Flag validation BEFORE any filesystem mutation: the out-dir
+    # delete below is destructive, and a bad flag combination must
+    # fail loudly even when the input directory turns out degenerate.
+    if stripes is not None:
+        if multi_out or get_cc:
+            raise ValueError(
+                "--stripes composes with the plain BOX output only "
+                "(use the batched path for --multi_out/--get_cc)"
+            )
+        if stripes < 1:
+            raise ValueError(f"--stripes must be >= 1, got {stripes}")
+        if use_pallas:
+            import warnings
+
+            warnings.warn(
+                "--pallas applies to the batched dense path only; "
+                "the striped (--stripes) path uses the bucketed/"
+                "dense XLA kernels",
+                stacklevel=2,
+            )
+
     timer = StageTimer()
     t0 = time.time()
     pickers = box_io.discover_picker_dirs(in_dir)
@@ -821,22 +842,6 @@ def run_consensus_dir(
     n_dev = len(jax.devices()) if use_mesh else 1
 
     if stripes is not None:
-        if multi_out or get_cc:
-            raise ValueError(
-                "--stripes composes with the plain BOX output only "
-                "(use the batched path for --multi_out/--get_cc)"
-            )
-        if stripes < 1:
-            raise ValueError(f"--stripes must be >= 1, got {stripes}")
-        if use_pallas:
-            import warnings
-
-            warnings.warn(
-                "--pallas applies to the batched dense path only; "
-                "the striped (--stripes) path uses the bucketed/"
-                "dense XLA kernels",
-                stacklevel=2,
-            )
         from repic_tpu.pipeline.giant import run_consensus_giant
 
         compute_s = 0.0

@@ -1,0 +1,96 @@
+"""Worker for the multi-process striped (giant-micrograph) test.
+
+Launched twice by tests/test_distributed.py.  Each process builds the
+SAME deterministic stripe decomposition of one giant micrograph
+(striping is a pure function of the replicated input, so no data
+needs to move between hosts), enumerates ONLY its own stripe range on
+its local device, and writes its clique shard.  The parent combines
+the shards and runs the one global solve — the deployment shape of
+the particle-axis path on a multi-host pod: enumeration needs no
+cross-host communication at all (the halo is carved from the
+replicated input, the spatial analog of a ring-attention shard
+exchange that has already happened at load time), and only the tiny
+clique set crosses hosts for the global packing solve.
+"""
+
+import os
+import re
+import sys
+
+
+def make_giant_workload():
+    """The deterministic giant micrograph both the workers and the
+    parent test's reference run build — ONE definition, so the
+    equality assertion always compares identical inputs.
+
+    Returns ``(sets, box)``.
+    """
+    import numpy as np
+
+    from repic_tpu.utils.box_io import BoxSet
+
+    rng = np.random.default_rng(17)
+    n, k, box = 600, 3, 180.0
+    base = rng.uniform(100, 9000, size=(n, 2)).astype(np.float32)
+    sets = [
+        BoxSet(
+            xy=base + rng.normal(0, 10, base.shape).astype(np.float32),
+            conf=rng.uniform(0.05, 1.0, size=n).astype(np.float32),
+            wh=np.full((n, 2), box, np.float32),
+        )
+        for _ in range(k)
+    ]
+    return sets, box
+
+
+def main():
+    out_dir = sys.argv[1]
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", flags
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("REPIC_TPU_NO_CACHE", "1")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from repic_tpu.parallel import distributed
+
+    assert distributed.initialize() is True
+    pid = jax.process_index()
+
+    import numpy as np
+
+    from repic_tpu.pipeline.giant import (
+        _make_striped_enum,
+        build_stripes,
+    )
+
+    # deterministic giant micrograph, replicated on every process
+    sets, box = make_giant_workload()
+
+    n_stripes = 4  # 2 per process
+    xy, conf, mask, l2g = build_stripes(sets, n_stripes, box)
+    rows = distributed.shard_for_process(list(range(n_stripes)))
+
+    # local enumeration of the owned stripe rows only (no mesh — the
+    # cross-host story is the combine, not the enumerate)
+    fn = _make_striped_enum(0.3, 16, 2048, None, None, 64, 2048)
+    cs = fn(xy[rows], conf[rows], mask[rows], float(box))
+
+    np.savez(
+        os.path.join(out_dir, f"stripes{pid}.npz"),
+        rows=np.asarray(rows),
+        member_idx=np.asarray(cs.member_idx),
+        valid=np.asarray(cs.valid),
+        w=np.asarray(cs.w),
+        l2g=l2g[rows],
+        max_adjacency=int(np.asarray(cs.max_adjacency).max()),
+    )
+
+
+if __name__ == "__main__":
+    main()

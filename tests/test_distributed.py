@@ -83,6 +83,87 @@ def test_throughput_bench_end_to_end(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_striped_giant_matches_single(tmp_path):
+    """Multi-host composition of the particle-axis path: two processes
+    each enumerate their own stripe range of ONE giant micrograph
+    (striping is a pure function of the replicated input — no
+    cross-host data motion), the parent combines the clique shards
+    and runs the global solve, and the result must equal the
+    single-process striped run exactly."""
+    import numpy as np
+
+    port = _free_port()
+    repo_root = os.path.dirname(os.path.dirname(__file__))
+    workers = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            JAX_NUM_PROCESSES="2",
+            JAX_PROCESS_ID=str(pid),
+            PYTHONPATH=repo_root
+            + os.pathsep
+            + env.get("PYTHONPATH", ""),
+        )
+        workers.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    os.path.join(
+                        os.path.dirname(__file__), "striped_worker.py"
+                    ),
+                    str(tmp_path),
+                ],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for w in workers:
+        out, _ = w.communicate(timeout=240)
+        outs.append(out)
+    for w, out in zip(workers, outs):
+        assert w.returncode == 0, f"worker failed:\n{out[-3000:]}"
+
+    # combine shards: local member indices -> global via each shard's
+    # own l2g table, in stripe-row order
+    combined = {}
+    for pid in range(2):
+        z = np.load(tmp_path / f"stripes{pid}.npz")
+        assert z["max_adjacency"] <= 16  # capacities were sufficient
+        for r, row in enumerate(z["rows"]):
+            member = z["member_idx"][r][z["valid"][r]]
+            l2g = z["l2g"][r]
+            k = member.shape[1]
+            glob = np.stack(
+                [l2g[p][member[:, p]] for p in range(k)], axis=1
+            )
+            combined[int(row)] = (glob, z["w"][r][z["valid"][r]])
+    assert sorted(combined) == [0, 1, 2, 3]
+
+    # single-process striped reference on the identical workload (ONE
+    # workload definition, shared with the workers)
+    from striped_worker import make_giant_workload
+
+    from repic_tpu.pipeline.giant import run_consensus_giant
+
+    sets, box = make_giant_workload()
+    ref = run_consensus_giant(
+        sets, box, n_stripes=4, use_mesh=False
+    )
+    want = {
+        tuple(r) for r in ref["member_idx"][ref["valid"]].tolist()
+    }
+    got_member = np.concatenate(
+        [combined[r][0] for r in sorted(combined)]
+    )
+    got = {tuple(r) for r in got_member.tolist()}
+    assert got == want and len(got_member) == len(want)
+
+
+@pytest.mark.slow
 def test_two_process_consensus_matches_single(tmp_path):
     port = _free_port()
     workers = []
