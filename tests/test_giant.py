@@ -175,6 +175,36 @@ def test_dir_striped_output_equals_batched(tmp_path):
         )
 
 
+def test_striped_mixed_k5_equals_unstriped():
+    """k=5 mixed-box-size ensembles (the staged-join regime) through
+    the striped path: any stripe count preserves the clique set."""
+    sizes = np.asarray([180.0, 120.0, 180.0, 120.0, 180.0], np.float32)
+    rng = np.random.default_rng(21)
+    n = 400
+    base = rng.uniform(200, 9000, size=(n, 2)).astype(np.float32)
+    sets = []
+    for p in range(5):
+        xy = base + rng.normal(0, 8, base.shape).astype(np.float32)
+        sets.append(
+            BoxSet(
+                xy=xy,
+                conf=rng.uniform(0.05, 1.0, size=n).astype(np.float32),
+                wh=np.full((n, 2), sizes[p], np.float32),
+            )
+        )
+    base_res = run_consensus_giant(
+        sets, sizes, n_stripes=1, use_mesh=False, spatial=False
+    )
+    striped = run_consensus_giant(
+        sets, sizes, n_stripes=8, use_mesh=False, spatial=False
+    )
+    k = 5
+    assert _clique_keys(
+        striped["member_idx"][striped["valid"]], k
+    ) == _clique_keys(base_res["member_idx"][base_res["valid"]], k)
+    assert striped["num_cliques"] == base_res["num_cliques"] > 0
+
+
 def test_empty_and_tiny_stripes():
     """More stripes than anchors: the extra stripes are empty and the
     result still matches."""
