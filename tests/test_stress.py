@@ -114,3 +114,67 @@ def test_repeat_batches_reuse_probed_config():
         C._make_batched_consensus.cache_info().currsize
         == size_after_first
     )  # no second program compiled for the same shape
+
+
+def test_outlier_chunk_does_not_promote_base_config():
+    """One dense outlier chunk must not double every later chunk's
+    program: the recorded config tracks the TYPICAL chunk (lower
+    median of the last three requirements) — an isolated outlier
+    escalates locally without promoting it, two consecutive outliers
+    promote it, and it demotes again once dense chunks stop arriving
+    (the pre-policy behavior cost a measured 1.8x on the
+    1024-directory workload)."""
+    import repic_tpu.pipeline.consensus as C
+
+    rng = np.random.default_rng(7)
+    n = 48
+
+    def batch(dense):
+        if dense:
+            # one tight cluster: adjacency ~ n, far above the base
+            base_xy = rng.uniform(500, 560, size=(n, 2))
+        else:
+            # spread grid: adjacency ~ 1
+            gx, gy = np.meshgrid(np.arange(8), np.arange(6))
+            base_xy = (
+                np.stack([gx, gy], -1).reshape(-1, 2)[:n] * 400.0
+                + 200.0
+            )
+        xy = np.stack(
+            [
+                base_xy + rng.normal(0, 5, base_xy.shape)
+                for _ in range(2)
+            ]
+        )[None].astype(np.float32)
+        conf = rng.uniform(0.1, 1, size=(1, 2, n)).astype(np.float32)
+        return PaddedBatch(
+            xy=xy,
+            conf=conf,
+            mask=np.ones((1, 2, n), bool),
+            names=("m0",),
+            counts=np.full((1, 2), n, np.int32),
+        )
+
+    key = ((1, 2, n, 2), (180.0,), 0.3, False)
+    C._LAST_GOOD_CONFIG.pop(key, None)
+    C._RECENT_REQUIREMENTS.pop(key, None)
+
+    C.run_consensus_batch(batch(False), 180.0, use_mesh=False)
+    base_cfg = C._LAST_GOOD_CONFIG[key]
+
+    res = C.run_consensus_batch(batch(True), 180.0, use_mesh=False)
+    assert int(np.asarray(res.num_cliques)) > 0  # outlier still solved
+    assert C._LAST_GOOD_CONFIG[key] == base_cfg  # base not promoted
+
+    C.run_consensus_batch(batch(False), 180.0, use_mesh=False)
+    assert C._LAST_GOOD_CONFIG[key] == base_cfg  # still the base
+
+    C.run_consensus_batch(batch(True), 180.0, use_mesh=False)
+    C.run_consensus_batch(batch(True), 180.0, use_mesh=False)
+    promoted = C._LAST_GOOD_CONFIG[key]
+    assert promoted[0] > base_cfg[0]  # consecutive outliers promote
+
+    C.run_consensus_batch(batch(False), 180.0, use_mesh=False)
+    C.run_consensus_batch(batch(False), 180.0, use_mesh=False)
+    # dense chunks stopped arriving: the config demotes again
+    assert C._LAST_GOOD_CONFIG[key][0] == base_cfg[0]
