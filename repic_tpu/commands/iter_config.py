@@ -70,6 +70,13 @@ def add_arguments(parser):
         default="iter_config.json",
         help="path for created config file",
     )
+    parser.add_argument(
+        "--bf16",
+        action="store_true",
+        help="builtin pickers only: bfloat16 conv/matmul compute for "
+        "training and bulk scoring (MXU-native; checkpoints stay "
+        "float32) — written as compute_dtype in the config",
+    )
 
 
 def _conda_envs():
@@ -130,8 +137,11 @@ def main(args):
     params = {
         k: v
         for k, v in vars(args).items()
-        if k not in ("command", "func", "out_file_path", "platform")
+        if k not in (
+            "command", "func", "out_file_path", "platform", "bf16",
+        )
     }
+    params["compute_dtype"] = "bfloat16" if args.bf16 else "float32"
     print(f"Writing config file to {args.out_file_path}")
     with open(args.out_file_path, "wt") as o:
         json.dump(params, o, indent=4)

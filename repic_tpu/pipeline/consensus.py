@@ -299,17 +299,18 @@ def _make_spatial_probe(grid: int, cell_capacity: int, threshold: float):
 # Last sufficient (max_neighbors, clique_capacity, cell_capacity) per
 # workload shape: each distinct capacity config costs a full XLA
 # compile, so repeated batches of the same shape skip the escalation
-# ladder entirely.  The record tracks the TYPICAL batch: it is the
-# per-component lower median of the last three observed requirements
-# (_RECENT_REQUIREMENTS).  Staged-join work scales with the
-# capacities, so letting ONE dense outlier chunk promote the config
-# silently doubled every later chunk's program (measured 1.8x on the
-# 1024-directory workload); the median ignores an isolated outlier
-# (it escalates locally and pays its own re-run), follows a
-# persistent shift up after two consecutive large chunks, and demotes
-# again once large chunks stop arriving.  Executables for every
-# visited config stay in the jit/lru caches, so oscillation costs an
-# overflow re-run, never a fresh compile.
+# ladder entirely.  The record tracks the TYPICAL batch: the
+# lower-median (by total-work proxy) of the last three observed
+# requirement tuples (_RECENT_REQUIREMENTS).  Adopting a config costs
+# at most one compile the first time it is visited (cached after);
+# staged-join work scales with the capacities, so letting ONE dense
+# outlier chunk promote the config silently doubled every later
+# chunk's program (measured 1.8x on the 1024-directory workload); the
+# median ignores an isolated outlier (it escalates locally and pays
+# its own re-run), follows a shift up once two of the last three
+# chunks need it, and demotes again when large chunks stop arriving.
+# Oscillation costs an overflow re-run of the occasional
+# under-provisioned chunk, never a fresh compile.
 _LAST_GOOD_CONFIG: dict = {}
 _RECENT_REQUIREMENTS: dict = {}
 
@@ -523,19 +524,23 @@ def run_consensus_batch(
         req = (
             _next_pow2(max(max_adj, 2)),
             max(_next_pow2(max(n_cliques, 2)), 1024),
-            _next_pow2(max(max_cell, 8)) if grid is not None else cell_cap,
+            # same floor as the first-visit probe (cheap sparse grids
+            # stay at their probed capacity instead of forcing a
+            # second functionally-equivalent compile at a higher one)
+            _next_pow2(max(max_cell, 2)) if grid is not None else cell_cap,
             _next_pow2(max_part) if max_part > 0 else pcap,
         )
         recent = _RECENT_REQUIREMENTS.setdefault(cfg_key, [])
         recent.append(req)
         del recent[:-3]
-        # per-component lower median of the last <=3 requirements:
-        # robust to one outlier, follows two consecutive ones, demotes
-        # when they stop
-        _LAST_GOOD_CONFIG[cfg_key] = tuple(
-            sorted(c)[(len(recent) - 1) // 2]
-            for c in zip(*recent)
+        # lower-median requirement TUPLE of the last <=3 (ordered by a
+        # total-work proxy): robust to one outlier, follows two of
+        # three, demotes when they stop.  A coherent observed tuple —
+        # never a per-component mixture no workload exhibited.
+        by_cost = sorted(
+            recent, key=lambda r: (r[0] * r[1] * r[2] * r[3], r)
         )
+        _LAST_GOOD_CONFIG[cfg_key] = by_cost[(len(recent) - 1) // 2]
         return res
 
 

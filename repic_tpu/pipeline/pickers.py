@@ -642,6 +642,12 @@ def build_pickers(config: dict) -> list:
                     seed=1234 + 1111 * i,
                     model_path=model,
                     arch=config.get(f"{pname}_arch", default_arch),
+                    # "bfloat16" runs the whole builtin ensemble's
+                    # training + bulk scoring on the MXU (config key:
+                    # compute_dtype, shared by all builtin slots)
+                    compute_dtype=config.get(
+                        "compute_dtype", "float32"
+                    ),
                 )
             )
         elif pname == "cryolo":

@@ -205,6 +205,45 @@ def test_build_pickers_shared_checkpoint_fallback():
     assert ps[1].model_path == "d.rptpu"
 
 
+def test_build_pickers_compute_dtype_from_config(tmp_path):
+    """iter_config --bf16 writes compute_dtype and the builtin
+    ensemble picks it up; absent key defaults to float32."""
+    from types import SimpleNamespace
+
+    from repic_tpu.commands import iter_config
+
+    base = {"box_size": 180}
+    assert all(
+        p.compute_dtype == "float32"
+        for p in pickers_mod.build_pickers(base)
+    )
+    ps = pickers_mod.build_pickers(
+        dict(base, compute_dtype="bfloat16")
+    )
+    assert all(p.compute_dtype == "bfloat16" for p in ps)
+
+    out = tmp_path / "cfg.json"
+    iter_config.main(
+        SimpleNamespace(
+            data_dir=str(tmp_path),
+            box_size=180,
+            exp_particles=100,
+            cryolo_model="builtin",
+            deep_dir="builtin",
+            topaz_scale=4,
+            topaz_rad=8,
+            cryolo_env="builtin",
+            deep_env="builtin",
+            topaz_env="builtin",
+            out_file_path=str(out),
+            bf16=True,
+        )
+    )
+    import json
+
+    assert json.load(open(out))["compute_dtype"] == "bfloat16"
+
+
 def test_builtin_picker_requires_model(tmp_path):
     p = pickers_mod.BuiltinPicker(name="b", particle_size=PARTICLE)
     with pytest.raises(pickers_mod.PickerError):

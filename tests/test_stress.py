@@ -119,11 +119,11 @@ def test_repeat_batches_reuse_probed_config():
 def test_outlier_chunk_does_not_promote_base_config():
     """One dense outlier chunk must not double every later chunk's
     program: the recorded config tracks the TYPICAL chunk (lower
-    median of the last three requirements) — an isolated outlier
-    escalates locally without promoting it, two consecutive outliers
-    promote it, and it demotes again once dense chunks stop arriving
-    (the pre-policy behavior cost a measured 1.8x on the
-    1024-directory workload)."""
+    median of the last three requirement tuples) — an isolated
+    outlier escalates locally without promoting it, two of the last
+    three chunks being large promotes it, and it demotes again once
+    dense chunks stop arriving (the pre-policy behavior cost a
+    measured 1.8x on the 1024-directory workload)."""
     import repic_tpu.pipeline.consensus as C
 
     rng = np.random.default_rng(7)
