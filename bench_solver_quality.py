@@ -148,6 +148,10 @@ def main():
     )
     args = ap.parse_args()
 
+    from bench import hold_chip_lock
+
+    _chip = hold_chip_lock()  # quiet the TPU watcher during timing
+
     if not args.device:
         import os
 

@@ -59,6 +59,10 @@ def main():
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
 
+    from bench import hold_chip_lock
+
+    _chip = hold_chip_lock()  # quiet the TPU watcher during timing
+
     if args.cpu:
         import os
 

@@ -21,9 +21,21 @@ from repic_tpu.utils.box_io import BoxSet
 
 
 def bucket_size(n: int, minimum: int = 64) -> int:
-    """Next power of two >= n (>= minimum) — recompile-stable padding."""
+    """Smallest value >= n from {2^k, 1.5 * 2^k} (>= minimum).
+
+    Recompile-stable padding, like pure powers of two, but with a
+    halfway step per octave: worst-case padding drops from ~100% of
+    the real count (n = 2^k + 1 padded to 2^(k+1)) to 50% (padded to
+    1.5 * 2^k) — which is quadratic work on the all-pairs paths (the
+    EMPIAR-10017 headline pads ~740 particles to 768 instead of 1024,
+    0.56x the IoU work) — while at most doubling the number of
+    distinct executables a shape family can produce.
+    """
     b = minimum
     while b < n:
+        h = b + b // 2
+        if n <= h:
+            return h
         b *= 2
     return b
 

@@ -299,18 +299,19 @@ def _make_spatial_probe(grid: int, cell_capacity: int, threshold: float):
 # Last sufficient (max_neighbors, clique_capacity, cell_capacity) per
 # workload shape: each distinct capacity config costs a full XLA
 # compile, so repeated batches of the same shape skip the escalation
-# ladder entirely.  The record tracks the TYPICAL batch: the
-# lower-median (by total-work proxy) of the last three observed
-# requirement tuples (_RECENT_REQUIREMENTS).  Adopting a config costs
-# at most one compile the first time it is visited (cached after);
-# staged-join work scales with the capacities, so letting ONE dense
-# outlier chunk promote the config silently doubled every later
-# chunk's program (measured 1.8x on the 1024-directory workload); the
-# median ignores an isolated outlier (it escalates locally and pays
-# its own re-run), follows a shift up once two of the last three
-# chunks need it, and demotes again when large chunks stop arriving.
-# Oscillation costs an overflow re-run of the occasional
-# under-provisioned chunk, never a fresh compile.
+# ladder entirely.  The first visit records the config that actually
+# ran (its executable is cached — the very next call is free).  From
+# then on the record follows the TYPICAL batch: the lower-median (by
+# total-work proxy) of the last three observed requirement tuples
+# (_RECENT_REQUIREMENTS) — the median IS the stability mechanism
+# (adopting a config costs at most one compile the first time it is
+# visited; executables stay cached).  Staged-join work scales with
+# the capacities, so
+# letting ONE dense outlier chunk promote the config silently doubled
+# every later chunk's program (measured 1.8x on the 1024-directory
+# workload); the median ignores an isolated outlier (it escalates
+# locally and pays its own re-run), follows a shift two of the last
+# three chunks exhibit, and demotes again when large chunks stop.
 _LAST_GOOD_CONFIG: dict = {}
 _RECENT_REQUIREMENTS: dict = {}
 
@@ -533,6 +534,11 @@ def run_consensus_batch(
         recent = _RECENT_REQUIREMENTS.setdefault(cfg_key, [])
         recent.append(req)
         del recent[:-3]
+        if known is None:
+            # record what this call executed: the next same-shape call
+            # reuses its cached executable with zero compile cost
+            _LAST_GOOD_CONFIG[cfg_key] = (d, cap, cell_cap, pcap)
+            return res
         # lower-median requirement TUPLE of the last <=3 (ordered by a
         # total-work proxy): robust to one outlier, follows two of
         # three, demotes when they stop.  A coherent observed tuple —

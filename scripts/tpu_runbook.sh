@@ -60,7 +60,7 @@ probe() {
     local out
     out=$(timeout -k 10 "$PROBE_TIMEOUT" "$PY" -c \
         'import jax; print(jax.devices()[0].platform)' 2>/dev/null </dev/null \
-        | tail -n 1)
+        8>&- 9>&- | tail -n 1)
     [ "$out" = "tpu" ]
 }
 
@@ -83,8 +83,11 @@ step() {
         say "step $name: artifact $out already captured, skipping"
         return 0
     fi
+    # lock fds are NOT passed down (8>&- 9>&-): an orphaned child must
+    # never keep holding the watcher's locks after the watcher dies
     say "step $name: starting (timeout ${timeout_s}s): $*"
-    if timeout -k 10 "$timeout_s" "$@" >"$out.tmp" 2>>"$LOG" </dev/null; then
+    if timeout -k 10 "$timeout_s" "$@" >"$out.tmp" 2>>"$LOG" </dev/null \
+        8>&- 9>&-; then
         # Exit 0 is not enough: if the tunnel dropped between probe and
         # step, JAX silently falls back to CPU and the step "succeeds"
         # with CPU numbers — refuse to file those under a TPU artifact.
@@ -105,6 +108,10 @@ step() {
 }
 
 runbook() {
+    # The watcher already holds the chip flock (fd 9); its children
+    # must skip their own best-effort acquisition (a fresh fd in a
+    # child conflicts with the inherited lock).
+    export REPIC_CHIP_LOCK_HELD=1
     # bench.py --child measures directly on the default (TPU) platform —
     # fastest path to the headline number while the window is open; the
     # full bench.py CPU-first protocol is for driver runs, not chip

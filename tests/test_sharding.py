@@ -30,9 +30,14 @@ def _to_boxsets(sets):
 
 
 def test_bucket_size():
+    # {2^k, 1.5 * 2^k} steps: halfway buckets cap padding waste at 33%
     assert bucket_size(1) == 64
-    assert bucket_size(65) == 128
+    assert bucket_size(65) == 96
+    assert bucket_size(97) == 128
+    assert bucket_size(700) == 768
     assert bucket_size(800) == 1024
+    assert bucket_size(64) == 64
+    assert bucket_size(96) == 96
 
 
 def test_batch_padding_to_mesh(rng):
