@@ -1,13 +1,19 @@
 """Native (C++) runtime components, loaded via ctypes.
 
-The reference's only native-code dependency is the commercial Gurobi
-ILP core reached through ``gurobipy`` (reference: repic/commands/
-run_ilp.py:7,50-63).  This package provides the framework's own native
-equivalent: an exact branch-and-bound set-packing solver compiled from
-``setpack.cpp``.  Compilation happens lazily on first use (``g++ -O2
--shared -fPIC``) and the resulting shared object is cached next to the
-source; everything degrades gracefully to the pure-Python oracle in
-:mod:`repic_tpu.ops.solver` when no C++ toolchain is present.
+The reference's native-code surface is the commercial Gurobi ILP core
+reached through ``gurobipy`` (reference: repic/commands/
+run_ilp.py:7,50-63) plus the NumPy/pandas C kernels its Python leans
+on.  This package provides the framework's own native equivalents:
+
+* ``setpack.cpp`` — exact branch-and-bound set packing (the Gurobi
+  replacement);
+* ``boxparse.cpp`` — the BOX-file row parser (the data-loader hot
+  tier; batch workloads parse tens of thousands of files per run).
+
+Compilation happens lazily on first use (``g++ -O2 -shared -fPIC``)
+and each shared object is cached next to its source; everything
+degrades gracefully to the Python implementations when no C++
+toolchain is present.
 """
 
 from __future__ import annotations
@@ -21,25 +27,20 @@ import threading
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "setpack.cpp")
 _LOCK = threading.Lock()
-_LIB: ctypes.CDLL | None = None
-_LOAD_FAILED = False
+_LIBS: dict = {}  # stem -> CDLL | None (None = load failed)
 
 
-def _so_path() -> str:
-    return os.path.join(_HERE, "_setpack.so")
-
-
-def _build(force: bool = False) -> str | None:
-    """Compile setpack.cpp to a shared object; return its path or None."""
-    so = _so_path()
+def _build(stem: str, force: bool = False) -> str | None:
+    """Compile ``<stem>.cpp`` to ``_<stem>.so``; return path or None."""
+    src = os.path.join(_HERE, stem + ".cpp")
+    so = os.path.join(_HERE, f"_{stem}.so")
     tmp = None
     try:
         if (
             not force
             and os.path.exists(so)
-            and os.path.getmtime(so) >= os.path.getmtime(_SRC)
+            and os.path.getmtime(so) >= os.path.getmtime(src)
         ):
             return so
         # Build into a temp file then atomically rename, so concurrent
@@ -47,7 +48,7 @@ def _build(force: bool = False) -> str | None:
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
         os.close(fd)
         subprocess.run(
-            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", tmp],
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src, "-o", tmp],
             check=True,
             capture_output=True,
             timeout=120,
@@ -63,43 +64,91 @@ def _build(force: bool = False) -> str | None:
         return None
 
 
-def _load() -> ctypes.CDLL | None:
-    global _LIB, _LOAD_FAILED
-    if _LIB is not None or _LOAD_FAILED:
-        return _LIB
+def _load(stem: str, configure) -> ctypes.CDLL | None:
+    if stem in _LIBS:
+        return _LIBS[stem]
     with _LOCK:
-        if _LIB is not None or _LOAD_FAILED:
-            return _LIB
+        if stem in _LIBS:
+            return _LIBS[stem]
+        lib = None
         for attempt in range(2):
             # Second attempt force-rebuilds: a stale or foreign-arch
             # .so (e.g. restored by a checkout) fails CDLL but a fresh
             # local compile may succeed.
-            so = _build(force=attempt > 0)
+            so = _build(stem, force=attempt > 0)
             if so is None:
                 break
             try:
-                lib = ctypes.CDLL(so)
-                lib.setpack_solve.restype = ctypes.c_int32
-                lib.setpack_solve.argtypes = [
-                    ctypes.POINTER(ctypes.c_int32),
-                    ctypes.POINTER(ctypes.c_double),
-                    ctypes.c_int64,
-                    ctypes.c_int32,
-                    ctypes.c_int64,
-                    ctypes.POINTER(ctypes.c_uint8),
-                ]
-                _LIB = lib
+                candidate = ctypes.CDLL(so)
+                configure(candidate)
+                lib = candidate
                 break
-            except OSError:
+            except (OSError, AttributeError):
+                # AttributeError: a loadable-but-wrong .so missing the
+                # expected symbol — force-rebuild on attempt 2, cache
+                # the failure otherwise
                 continue
-        if _LIB is None:
-            _LOAD_FAILED = True
-    return _LIB
+        _LIBS[stem] = lib
+    return lib
+
+
+def _configure_setpack(lib) -> None:
+    lib.setpack_solve.restype = ctypes.c_int32
+    lib.setpack_solve.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+
+
+def _configure_boxparse(lib) -> None:
+    lib.boxparse_rows.restype = ctypes.c_long
+    lib.boxparse_rows.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_long,
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.c_long,
+    ]
 
 
 def native_available() -> bool:
     """True when the compiled solver is (or can be made) loadable."""
-    return _load() is not None
+    return _load("setpack", _configure_setpack) is not None
+
+
+def boxparse_available() -> bool:
+    """True when the compiled BOX parser is loadable."""
+    return _load("boxparse", _configure_boxparse) is not None
+
+
+def parse_box_native(data: bytes) -> np.ndarray | None:
+    """Parse raw BOX-file bytes into an ``(n, 5)`` float64 array.
+
+    Columns are ``x, y, w, h, conf`` with the Python loop's defaults
+    for short rows (w=h=0, conf=1).  Returns None when the native
+    library is unavailable OR the file needs the Python tiers (bad
+    tokens, short rows — whose error semantics the fallback preserves).
+    """
+    lib = _load("boxparse", _configure_boxparse)
+    if lib is None:
+        return None
+    # rows can be delimited by \n or \r (universal newlines)
+    max_rows = data.count(b"\n") + data.count(b"\r") + 2
+    out = np.empty((max_rows, 5), dtype=np.float64)
+    # c_char_p guarantees NUL termination (strtod may peek one past a
+    # token touching the end of the buffer)
+    n = lib.boxparse_rows(
+        ctypes.c_char_p(data),
+        ctypes.c_long(len(data)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_long(max_rows),
+    )
+    if n < 0:
+        return None
+    return out[:n]
 
 
 def solve_exact_native(
@@ -114,7 +163,7 @@ def solve_exact_native(
     returns None when the native library is unavailable so callers can
     fall back.
     """
-    lib = _load()
+    lib = _load("setpack", _configure_setpack)
     if lib is None:
         return None
     src = np.asarray(member_vertex)

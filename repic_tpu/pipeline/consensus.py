@@ -352,8 +352,11 @@ def last_good_config(
     )
 
 
-def _next_pow2(x: int) -> int:
-    # shared power-of-two bucketing policy (recompile-stable sizes)
+def _next_bucket(x: int) -> int:
+    # shared {2^k, 1.5*2^k} bucketing policy (recompile-stable sizes;
+    # capacities land on the same grid as padding, trading up to 2x
+    # more potential configs per component for a tighter work fit —
+    # escalation still jumps straight to the observed requirement)
     return bucket_size(int(x), minimum=2)
 
 
@@ -383,19 +386,19 @@ def escalate_capacities(probes, d, cap, cell_cap, pcap, *, has_grid):
     max_adj, n_cliques, max_cell, max_part = (int(v) for v in probes)
     retry = False
     if has_grid and max_cell > cell_cap:
-        cell_cap = _next_pow2(max_cell)
+        cell_cap = _next_bucket(max_cell)
         retry = True
     if max_adj > d:
-        d = _next_pow2(max_adj)
+        d = _next_bucket(max_adj)
         retry = True
     if n_cliques > cap:
-        cap = _next_pow2(n_cliques)
+        cap = _next_bucket(n_cliques)
         retry = True
     if max_part > pcap:
         # partial tuples live in their own (pcap, K) buffers, so
         # escalating them does not inflate the final clique buffers /
         # solver pack the way escalating `cap` would
-        pcap = _next_pow2(max_part)
+        pcap = _next_bucket(max_part)
         retry = True
     return d, cap, cell_cap, pcap, retry
 
@@ -466,17 +469,17 @@ def run_consensus_batch(
             # config is reused and the escalation loop below catches
             # data drift.
             cell = _make_cell_probe(grid)(batch.xy, batch.mask, box_arg)
-            cell_cap = _next_pow2(max(int(jnp.max(cell)), 2))
+            cell_cap = _next_bucket(max(int(jnp.max(cell)), 2))
             probe = _make_spatial_probe(grid, cell_cap, threshold)
             adj = probe(batch.xy, batch.mask, box_arg)
             # The probes give exact requirements; max_neighbors is
             # only a default — override in both directions.
-            d = _next_pow2(max(int(jnp.max(adj)), 2))
+            d = _next_bucket(max(int(jnp.max(adj)), 2))
     elif known is None:
         adj = _make_dense_probe(threshold)(
             batch.xy, batch.mask, box_arg
         )
-        d = _next_pow2(max(int(jnp.max(adj)), 2))
+        d = _next_bucket(max(int(jnp.max(adj)), 2))
     if known:
         # Trust the recorded adequate config COMPLETELY.  Mixing it
         # with the caller defaults (e.g. max(d, known_d)) re-anchors
@@ -523,13 +526,13 @@ def run_consensus_batch(
             int(v) for v in probes
         )
         req = (
-            _next_pow2(max(max_adj, 2)),
-            max(_next_pow2(max(n_cliques, 2)), 1024),
+            _next_bucket(max(max_adj, 2)),
+            max(_next_bucket(max(n_cliques, 2)), 1024),
             # same floor as the first-visit probe (cheap sparse grids
             # stay at their probed capacity instead of forcing a
             # second functionally-equivalent compile at a higher one)
-            _next_pow2(max(max_cell, 2)) if grid is not None else cell_cap,
-            _next_pow2(max_part) if max_part > 0 else pcap,
+            _next_bucket(max(max_cell, 2)) if grid is not None else cell_cap,
+            _next_bucket(max_part) if max_part > 0 else pcap,
         )
         recent = _RECENT_REQUIREMENTS.setdefault(cfg_key, [])
         recent.append(req)

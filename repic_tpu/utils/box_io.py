@@ -49,15 +49,39 @@ def _is_float(tok) -> bool:
 def read_box(path: str) -> BoxSet:
     """Parse a BOX file; empty files yield an empty :class:`BoxSet`.
 
-    Parsing is two-tier: a vectorized pandas C-engine path (the
-    50k-row stress files and 1024-micrograph batches are host-parse
-    bound on the pure-Python loop), falling back to the line loop —
-    which remains the semantic specification — for anything the fast
-    path cannot digest (odd headers, ragged rows)."""
+    Parsing is three-tier: the native C++ row parser
+    (``native/boxparse.cpp`` — one pass over the raw bytes, strtod per
+    token, bit-identical floats to CPython's), then the vectorized
+    pandas C-engine path, then the line loop — which remains the
+    semantic specification — for anything the faster tiers cannot
+    digest (odd headers, ragged rows, no toolchain).  The 50k-row
+    stress files and 1024-micrograph batches are host-parse bound
+    without the fast tiers."""
+    try:
+        arr = _read_box_native(path)
+        if arr is not None:
+            return arr
+    except Exception:
+        pass
     try:
         return _read_box_fast(path)
     except Exception:
         return _read_box_slow(path)
+
+
+def _read_box_native(path: str) -> BoxSet | None:
+    from repic_tpu.native import boxparse_available, parse_box_native
+
+    if not boxparse_available():  # cached; avoids double file reads
+        return None
+    with open(path, "rb") as f:
+        data = f.read()
+    arr = parse_box_native(data)
+    if arr is None:
+        return None
+    return _finish_box(
+        arr[:, 0], arr[:, 1], arr[:, 2], arr[:, 3], arr[:, 4]
+    )
 
 
 def _finish_box(

@@ -80,3 +80,120 @@ def test_roundtrip(tmp_path):
     bs = box_io.read_box(str(p))
     assert bs.n == 2
     np.testing.assert_allclose(sorted(bs.conf), [0.1, 0.9], rtol=1e-6)
+
+
+# --- native C++ parser tier (native/boxparse.cpp) -------------------
+
+CASES = {
+    "plain5": "10\t20\t180\t180\t0.5\n30\t40\t180\t180\t0.9\n",
+    "four_col": "10 20 180 180\n30 40 180 180\n",
+    "two_col": "10 20\n30 40\n",
+    "header": "x y w h conf\n10 20 180 180 0.5\n",
+    "blank_lines": "\n10 20 180 180 0.5\n\n\n30 40 180 180 0.9\n",
+    "neg_conf_sigmoid": "10 20 180 180 -1.5\n30 40 180 180 -0.2\n",
+    "no_trailing_newline": "10 20 180 180 0.5",
+    "float_formats": "1.5e2 .5 +180 180. 0.25\n",
+    "nan_token": "10 20 180 180 nan\n",
+    "signed_nan_inf": "-nan 20 180 180 inf\nInfinity 40 -inf 180 NAN\n",
+    "ragged_mixed": "10 20\n30 40 180\n50 60 180 180\n70 80 180 180 0.5\n",
+    "extra_cols_ignored": "10 20 180 180 0.5 EXTRA stuff\n",
+    "crlf": "10 20 180 180 0.5\r\n30 40 180 180 0.9\r\n",
+    "cr_only": "10 20 180 180 0.5\r30 40 180 180 0.9\r",
+    # float() rejects nan payload forms, so this is a header to both
+    "nan_payload_header": "nan(0) 20 w h c\n10 20 180 180 0.5\n",
+    "empty": "",
+    "whitespace_only": "  \n\t\n",
+}
+
+import pytest  # noqa: E402  (native tier tests below)
+
+from repic_tpu import native  # noqa: E402
+
+needs_boxparse = pytest.mark.skipif(
+    not native.boxparse_available(),
+    reason="no C++ toolchain for the native BOX parser",
+)
+
+
+@needs_boxparse
+def test_native_tier_matches_slow_loop(tmp_path):
+    """Every quirk case must parse bit-identically to the Python loop
+    (the semantic specification) through the full read_box tiering."""
+    for name, text in CASES.items():
+        p = tmp_path / f"{name}.box"
+        p.write_text(text)
+        got = box_io._read_box_native(str(p))
+        want = box_io._read_box_slow(str(p))
+        assert got is not None, f"{name}: native declined"
+        np.testing.assert_array_equal(got.xy, want.xy, err_msg=name)
+        np.testing.assert_array_equal(got.wh, want.wh, err_msg=name)
+        np.testing.assert_array_equal(
+            got.conf, want.conf, err_msg=name
+        )
+
+
+@needs_boxparse
+def test_native_declines_what_the_loop_rejects(tmp_path):
+    """Files the specification raises on must be declined by the
+    native tier (None), so the fallback chain raises identically."""
+    bad = {
+        "bad_token_mid_file": "10 20 180 180 0.5\n30 oops 180 180\n",
+        "one_column": "10\n",
+        "bad_second_token_first_line": "1.0 ycoord\n",
+    }
+    import pytest
+
+    for name, text in bad.items():
+        p = tmp_path / f"{name}.box"
+        p.write_text(text)
+        assert box_io._read_box_native(str(p)) is None, name
+        with pytest.raises(Exception):
+            box_io._read_box_slow(str(p))
+
+
+@needs_boxparse
+def test_native_declines_python_only_floats(tmp_path):
+    """Tokens only CPython's float() accepts (digit underscores) are
+    declined by the native tier, and the full read_box tiering still
+    lands on the loop's result.  A leading hex float, which float()
+    rejects, header-skips identically in both tiers."""
+    p = tmp_path / "u.box"
+    p.write_text("1_0 20 180 180 0.5\n")
+    assert box_io._read_box_native(str(p)) is None
+    bs = box_io.read_box(str(p))  # tiering falls through to the loop
+    np.testing.assert_allclose(bs.xy, [[10.0, 20.0]])
+
+    # unicode digits: float() parses them, strtod can't — and the
+    # native tier must DECLINE (not header-skip away a data row)
+    u = tmp_path / "ud.box"
+    u.write_text("١٢ 20 180 180 0.5\n10 20 180 180 0.7\n")
+    assert box_io._read_box_native(str(u)) is None
+    np.testing.assert_allclose(
+        box_io.read_box(str(u)).xy, [[12.0, 20.0], [10.0, 20.0]]
+    )
+
+    # digit-leading tokens strtod rejects are NEVER header-skipped by
+    # the native tier (they might be Python-parseable values); the
+    # tiering lands on the loop's header-skip where applicable
+    h = tmp_path / "h.box"
+    h.write_text("0x1p3 20 180 180 0.5\n")
+    assert box_io._read_box_native(str(h)) is None
+    assert box_io._read_box_slow(str(h)).n == 0
+    assert box_io.read_box(str(h)).n == 0
+
+
+@needs_boxparse
+def test_native_bit_identical_floats(tmp_path):
+    """strtod and CPython float() are both correctly rounded: parsed
+    doubles must be bit-identical on precision-torture values."""
+    vals = [
+        "0.1", "2.675", "1e-308", "1.7976931348623157e308",
+        "3.141592653589793238462643", "9007199254740993",
+    ]
+    text = "\n".join(f"{v} {v} {v} {v} {v}" for v in vals) + "\n"
+    p = tmp_path / "t.box"
+    p.write_text(text)
+    got = box_io._read_box_native(str(p))
+    want = box_io._read_box_slow(str(p))
+    for a, b in ((got.xy, want.xy), (got.wh, want.wh)):
+        assert a.tobytes() == b.tobytes()
