@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+"""Fold captured TPU artifacts into docs/tpu.md (auto-generated section).
+
+Run by scripts/tpu_runbook.sh after a successful window capture (and
+safe to run by hand).  Reads whichever of
+
+    BENCH_TPU_<tag>.json            headline (bench.py --child line)
+    PALLAS_TPU_<tag>.jsonl          kernel-vs-XLA rows (bench_pallas.py)
+    BREAKDOWN_TPU_<tag>_{headline,stress,batch1024}.jsonl
+
+exist in the repo root and rewrites the marked auto-generated section
+of docs/tpu.md with a measured-numbers table, leaving the rest of the
+file untouched.  Idempotent: the section is replaced between markers,
+appended at the end of the file if absent.
+"""
+
+import argparse
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+DOC = os.path.join(ROOT, "docs", "tpu.md")
+BEGIN = "<!-- BEGIN AUTO TPU CAPTURE -->"
+END = "<!-- END AUTO TPU CAPTURE -->"
+
+
+def _rows(path):
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith("{"):
+                    out.append(json.loads(line))
+    except OSError:
+        pass
+    return out
+
+
+def build_section(tag: str) -> str | None:
+    lines = [
+        BEGIN,
+        "",
+        f"## TPU window capture ({tag}, auto-generated)",
+        "",
+        "Numbers measured on the real chip by `scripts/tpu_runbook.sh`"
+        " during a healthy tunnel window; artifacts committed next to"
+        " this file's repo root.",
+        "",
+    ]
+    found = False
+
+    bench = _rows(os.path.join(ROOT, f"BENCH_TPU_{tag}.json"))
+    if bench:
+        b = bench[-1]
+        found = True
+        lines += [
+            f"* **Headline** (`BENCH_TPU_{tag}.json`): "
+            f"{b.get('value')} micrographs/s on "
+            f"{b.get('platform')} — {b.get('vs_baseline')}x the "
+            f"reference baseline (warm {b.get('warm_total_s')} s, "
+            f"first call {b.get('first_call_s')} s).",
+        ]
+
+    for wl in ("headline", "stress", "batch1024"):
+        rows = _rows(
+            os.path.join(ROOT, f"BREAKDOWN_TPU_{tag}_{wl}.jsonl")
+        )
+        for r in rows:
+            found = True
+            extras = []
+            if r.get("device_exec_s") is not None:
+                extras.append(f"device exec {r['device_exec_s']} s")
+            if r.get("achieved_gbps") is not None:
+                extras.append(f"{r['achieved_gbps']} GB/s achieved")
+            if r.get("hbm_utilization_pct") is not None:
+                extras.append(
+                    f"{r['hbm_utilization_pct']}% of the 819 GB/s "
+                    "HBM roofline"
+                )
+            lines.append(
+                f"* **Breakdown/{wl}**: "
+                f"{r.get('rate_micrographs_per_s')} micrographs/s"
+                + (" (" + ", ".join(extras) + ")" if extras else "")
+                + "."
+            )
+
+    pallas = _rows(os.path.join(ROOT, f"PALLAS_TPU_{tag}.jsonl"))
+    for r in pallas:
+        found = True
+        lines.append(
+            f"* **Pallas n={r.get('n')} d={r.get('d')}**: kernel "
+            f"{r.get('pallas_ms')} ms vs XLA matrix path "
+            f"{r.get('xla_ms')} ms (agree={r.get('agree')})."
+        )
+
+    if not found:
+        return None
+    lines += ["", END]
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("tag", nargs="?", default="r5")
+    args = ap.parse_args()
+    section = build_section(args.tag)
+    if section is None:
+        print("no TPU artifacts found; docs unchanged")
+        return
+    with open(DOC) as f:
+        doc = f.read()
+    if BEGIN in doc and END in doc:
+        head, rest = doc.split(BEGIN, 1)
+        _, tail = rest.split(END, 1)
+        doc = head + section + tail
+    else:
+        doc = doc.rstrip() + "\n\n" + section + "\n"
+    with open(DOC, "wt") as f:
+        f.write(doc)
+    print(f"docs/tpu.md: auto section refreshed for {args.tag}")
+
+
+if __name__ == "__main__":
+    main()

@@ -130,6 +130,8 @@ runbook() {
     "$PY" -c 'import sys, bench
 lines = [l for l in open(sys.argv[1]) if l.strip().startswith("{")]
 if lines: bench._record_tpu_success(lines[-1])' "$BENCH_OUT" 2>>"$LOG"
+    # Fold the captured numbers into docs/tpu.md (auto section).
+    "$PY" "$(dirname "$0")/refresh_tpu_docs.py" "$TAG" >>"$LOG" 2>&1
     return 0
 }
 
