@@ -197,3 +197,38 @@ def test_native_bit_identical_floats(tmp_path):
     want = box_io._read_box_slow(str(p))
     for a, b in ((got.xy, want.xy), (got.wh, want.wh)):
         assert a.tobytes() == b.tobytes()
+
+
+@needs_boxparse
+def test_native_random_float_sweep(tmp_path):
+    """Randomized torture: thousands of doubles in varied textual
+    formats must parse bit-identically to the Python loop."""
+    rng = np.random.default_rng(123)
+    vals = np.concatenate([
+        rng.uniform(-1e6, 1e6, 500),
+        rng.uniform(-1, 1, 500) * 10.0 ** rng.integers(-300, 300, 500),
+        np.float64(rng.integers(-(2**62), 2**62, 200)),
+    ])
+    fmts = ["%r", "%.17g", "%.6e", "%.12f", "%g"]
+    lines = []
+    for i, v in enumerate(vals):
+        f = fmts[i % len(fmts)]
+        s = repr(float(v)) if f == "%r" else f % v
+        lines.append(f"{s} {s} {s} {s} {s}")
+    p = tmp_path / "sweep.box"
+    text = "\n".join(lines) + "\n"
+    p.write_text(text)
+    # raw float64 comparison (before BoxSet's float32 cast): strtod_l
+    # and CPython float() are both correctly rounded, so every double
+    # must be BIT-identical
+    arr = native.parse_box_native(text.encode())
+    assert arr is not None
+    want64 = np.array(
+        [[float(t) for t in ln.split()] for ln in lines], np.float64
+    )
+    assert arr.tobytes() == want64.tobytes()
+    # and the full BoxSet path agrees post-cast
+    got = box_io._read_box_native(str(p))
+    want = box_io._read_box_slow(str(p))
+    assert got.xy.tobytes() == want.xy.tobytes()
+    assert got.conf.tobytes() == want.conf.tobytes()
