@@ -193,8 +193,11 @@ def test_native_bit_identical_floats(tmp_path):
     text = "\n".join(f"{v} {v} {v} {v} {v}" for v in vals) + "\n"
     p = tmp_path / "t.box"
     p.write_text(text)
-    got = box_io._read_box_native(str(p))
-    want = box_io._read_box_slow(str(p))
+    # torture magnitudes overflow the BoxSet float32 cast identically
+    # in both tiers; that cast warning is not under test
+    with np.errstate(over="ignore"):
+        got = box_io._read_box_native(str(p))
+        want = box_io._read_box_slow(str(p))
     for a, b in ((got.xy, want.xy), (got.wh, want.wh)):
         assert a.tobytes() == b.tobytes()
 
@@ -227,8 +230,10 @@ def test_native_random_float_sweep(tmp_path):
         [[float(t) for t in ln.split()] for ln in lines], np.float64
     )
     assert arr.tobytes() == want64.tobytes()
-    # and the full BoxSet path agrees post-cast
-    got = box_io._read_box_native(str(p))
-    want = box_io._read_box_slow(str(p))
+    # and the full BoxSet path agrees post-cast (torture magnitudes
+    # overflow the float32 cast identically in both tiers)
+    with np.errstate(over="ignore"):
+        got = box_io._read_box_native(str(p))
+        want = box_io._read_box_slow(str(p))
     assert got.xy.tobytes() == want.xy.tobytes()
     assert got.conf.tobytes() == want.conf.tobytes()
