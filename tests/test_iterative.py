@@ -269,9 +269,12 @@ def test_build_pickers_from_config():
 
 
 @pytest.mark.slow
-def test_iterative_end_to_end_builtin(dataset, tmp_path):
+@pytest.mark.parametrize("compute_dtype", ["float32", "bfloat16"])
+def test_iterative_end_to_end_builtin(dataset, tmp_path, compute_dtype):
     """Semi-auto round 0 from manual labels, one retraining round,
-    three builtin pickers, consensus recovers planted particles."""
+    three builtin pickers, consensus recovers planted particles —
+    under both compute dtypes (bfloat16 = the MXU-native path the
+    whole iterative pipeline runs with iter_config --bf16)."""
     data_dir, label_dir = dataset
     config = {
         "data_dir": data_dir,
@@ -280,6 +283,7 @@ def test_iterative_end_to_end_builtin(dataset, tmp_path):
         "cryolo_env": "builtin",
         "deep_env": "builtin",
         "topaz_env": "builtin",
+        "compute_dtype": compute_dtype,
     }
     out_dir = str(tmp_path / "run")
     state = iterative.run_iterative(
