@@ -75,14 +75,28 @@ def add_arguments(parser):
         "ignored with a warning when the spatial/bucketed search "
         "is selected (--spatial on, or auto above 4096 particles)",
     )
+    import argparse
+
+    def _stripes_arg(value):
+        if value == "auto":
+            return value
+        try:
+            return int(value)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"expected an integer or 'auto', got {value!r}"
+            ) from None
+
     parser.add_argument(
         "--stripes",
-        type=int,
+        type=_stripes_arg,
         metavar="S",
         help="particle-axis sharding: split EACH micrograph into S "
         "device-owned x-stripes with a box-size halo and shard the "
         "stripes over the mesh (sequence-parallel analog for giant "
-        "micrographs; output is identical to the unsharded path)",
+        "micrographs; output is identical to the unsharded path). "
+        "'auto' stripes only when it pays: fewer micrographs than "
+        "devices AND dense fields",
     )
 
 

@@ -816,7 +816,10 @@ def run_consensus_dir(
     # Flag validation BEFORE any filesystem mutation: the out-dir
     # delete below is destructive, and a bad flag combination must
     # fail loudly even when the input directory turns out degenerate.
-    if stripes is not None:
+    # ("auto" resolves after loading — it never stripes when the
+    # requested output needs the batched path, so it conflicts with
+    # nothing.)
+    if stripes is not None and stripes != "auto":
         if multi_out or get_cc:
             raise ValueError(
                 "--stripes composes with the plain BOX output only "
@@ -890,6 +893,31 @@ def run_consensus_dir(
 
     timer.stages.append(("load", time.time() - t0))
     n_dev = len(jax.devices()) if use_mesh else 1
+
+    if stripes == "auto":
+        # Stripe only when it pays: fewer micrographs than devices
+        # (the batched axis would leave devices idle) AND dense fields
+        # (enumeration is the dominant cost worth splitting).  The
+        # table flags need the batched path, so auto never conflicts.
+        max_n = max(
+            (bs.n for _, sets in loaded for bs in sets), default=0
+        )
+        if (
+            not (multi_out or get_cc)
+            and len(loaded) < n_dev
+            and max_n > SPATIAL_THRESHOLD
+        ):
+            stripes = n_dev
+            if use_pallas:
+                import warnings
+
+                warnings.warn(
+                    "--pallas applies to the batched dense path "
+                    "only; --stripes auto selected the striped path",
+                    stacklevel=2,
+                )
+        else:
+            stripes = None
 
     if stripes is not None:
         from repic_tpu.pipeline.giant import run_consensus_giant

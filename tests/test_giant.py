@@ -205,6 +205,43 @@ def test_striped_mixed_k5_equals_unstriped():
     assert striped["num_cliques"] == base_res["num_cliques"] > 0
 
 
+def test_stripes_auto_resolution(tmp_path):
+    """'auto' stripes only when micrographs < devices AND fields are
+    dense; otherwise it silently takes the batched path (including
+    with the table flags, which need it)."""
+    from repic_tpu.pipeline.consensus import run_consensus_dir
+    from repic_tpu.utils.box_io import write_box
+
+    src = tmp_path / "in"
+    for p in range(2):
+        d = src / f"picker{p}"
+        d.mkdir(parents=True)
+        s = _field(200, k=1, seed=p)[0]
+        write_box(str(d / "m0.box"), s.xy, s.conf, BOX)
+    # sparse single micrograph on the 8-device mesh: auto -> batched
+    stats = run_consensus_dir(
+        str(src), str(tmp_path / "o1"), int(BOX), stripes="auto"
+    )
+    assert "stripes" not in stats
+    # auto + multi_out must not conflict (resolves to batched)
+    stats = run_consensus_dir(
+        str(src), str(tmp_path / "o2"), int(BOX),
+        stripes="auto", multi_out=True,
+    )
+    assert "stripes" not in stats
+    # dense single micrograph, fewer micrographs than devices: stripes
+    src2 = tmp_path / "in2"
+    for p in range(2):
+        d = src2 / f"picker{p}"
+        d.mkdir(parents=True)
+        s = _field(5000, k=1, seed=p)[0]
+        write_box(str(d / "m0.box"), s.xy, s.conf, BOX)
+    stats = run_consensus_dir(
+        str(src2), str(tmp_path / "o3"), int(BOX), stripes="auto"
+    )
+    assert stats.get("stripes", 0) >= 8
+
+
 def test_empty_and_tiny_stripes():
     """More stripes than anchors: the extra stripes are empty and the
     result still matches."""
