@@ -316,10 +316,16 @@ def main():
 
     # Hold the shared chip lock for the whole run: it serializes TPU
     # access AND quiets the watcher's probe children, whose jax
-    # imports measurably pollute the single-core CPU reference.
+    # imports measurably pollute the single-core CPU reference.  When
+    # an ancestor already holds it (REPIC_CHIP_LOCK_HELD), the chip is
+    # effectively ours — contending with the ancestor's own flock
+    # would misread it as "busy" for the whole TPU window.
     chip = hold_chip_lock()
+    held = chip is not None or bool(
+        os.environ.get("REPIC_CHIP_LOCK_HELD")
+    )
     try:
-        return _run_benchmark(chip_held=chip is not None)
+        return _run_benchmark(chip_held=held)
     finally:
         if chip is not None:
             chip.close()
@@ -374,17 +380,27 @@ def _run_benchmark(chip_held: bool):
             local, lock_err = _try_chip_lock()
             if local is None:
                 if lock_err is not None:
-                    last_err = lock_err  # config error, not "busy"
-                elif not last_err:
-                    # Don't overwrite a real measurement-failure
-                    # reason with the generic busy string.
-                    last_err = (
-                        "chip lock held (another TPU measurement "
-                        "in flight)"
+                    # Config error (unusable lock path) — documented
+                    # as distinct from "chip busy": proceed UNLOCKED
+                    # instead of burning the TPU window on retries.
+                    print(
+                        f"{lock_err}; proceeding without the chip "
+                        "lock",
+                        file=sys.stderr,
+                        flush=True,
                     )
-                if not _wait_for_retry("chip busy"):
-                    break
-                continue
+                    chip_held = True  # stop attempting the lock
+                else:
+                    if not last_err:
+                        # Don't overwrite a real measurement-failure
+                        # reason with the generic busy string.
+                        last_err = (
+                            "chip lock held (another TPU "
+                            "measurement in flight)"
+                        )
+                    if not _wait_for_retry("chip busy"):
+                        break
+                    continue
         probe_unhealthy = False
         ok = False
         try:
