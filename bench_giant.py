@@ -1,0 +1,111 @@
+#!/usr/bin/env python3
+"""Striped (particle-axis sharded) vs unstriped giant-micrograph bench.
+
+Measures one dense micrograph through ``run_consensus_giant`` at
+``--stripes`` and at 1 stripe (same code path, no decomposition), and
+reports the decomposition overhead — on one device the stripes
+time-slice, so the overhead is the halo duplication plus per-stripe
+padding that a real mesh amortizes into a near-linear device-time
+win.  Clique-set identity between the two runs is asserted, not
+assumed.
+
+One JSON line; ``--out`` appends it to an artifact (GIANT_*.json).
+CPU-forced by default so the TPU watcher keeps the chip.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=20_000)
+    ap.add_argument("--k", type=int, default=3)
+    ap.add_argument("--stripes", type=int, default=8)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--box", type=float, default=180.0)
+    ap.add_argument("--out", help="append the JSON line to this file")
+    ap.add_argument(
+        "--device", action="store_true",
+        help="run on the default (device) backend instead of CPU",
+    )
+    args = ap.parse_args()
+
+    from bench import hold_chip_lock
+
+    _chip = hold_chip_lock()  # quiet the TPU watcher during timing
+    if not args.device:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from bench_stress import synthesize
+    from repic_tpu.pipeline.giant import run_consensus_giant
+    from repic_tpu.utils.box_io import BoxSet
+
+    platform = jax.devices()[0].platform
+    xy, conf, mask = synthesize(1, args.k, args.n, seed=2)
+    sets = [
+        BoxSet(
+            xy=xy[0, p],
+            conf=conf[0, p],
+            wh=np.full((args.n, 2), args.box, np.float32),
+        )
+        for p in range(args.k)
+    ]
+
+    results = {}
+    cliques = {}
+    for s_count in (1, args.stripes):
+        run_consensus_giant(  # warm-up / compile
+            sets, args.box, n_stripes=s_count, use_mesh=False
+        )
+        ts = []
+        for _ in range(args.reps):
+            t0 = time.time()
+            r = run_consensus_giant(
+                sets, args.box, n_stripes=s_count, use_mesh=False
+            )
+            ts.append(time.time() - t0)
+        results[s_count] = min(ts)
+        cliques[s_count] = {
+            tuple(row) for row in r["member_idx"][r["valid"]].tolist()
+        }
+    assert cliques[1] == cliques[args.stripes], (
+        "striped clique set diverged from unstriped"
+    )
+
+    line = json.dumps(
+        {
+            "metric": (
+                "giant-micrograph striped vs unstriped consensus "
+                "(single device; decomposition overhead)"
+            ),
+            "particles": args.n,
+            "pickers": args.k,
+            "platform": platform,
+            "stripes": args.stripes,
+            "unstriped_s": round(results[1], 3),
+            "striped_s": round(results[args.stripes], 3),
+            "overhead_pct": round(
+                100.0 * (results[args.stripes] / results[1] - 1.0), 1
+            ),
+            "cliques": len(cliques[1]),
+        }
+    )
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "at") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
