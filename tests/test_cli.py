@@ -355,3 +355,20 @@ def test_get_examples_update_manifest_pins_then_verifies(
                 "--manifest", str(manifest),
             ]
         )
+
+
+def test_help_surfaces_round5_flags(capsys):
+    """The round-5 flag surface must stay registered on the parser:
+    a refactor that drops one of these is a silent capability loss."""
+    for cmd, flags in [
+        ("consensus", ["--multi_out", "--get_cc", "--stripes"]),
+        ("fit", ["--bf16"]),
+        ("pick", ["--bf16"]),
+        ("score", ["--match", "--dist_rate"]),
+        ("iter_config", ["--bf16"]),
+    ]:
+        with pytest.raises(SystemExit):
+            cli_main([cmd, "--help"])
+        out = capsys.readouterr().out
+        for flag in flags:
+            assert flag in out, f"{cmd} lost {flag}"
