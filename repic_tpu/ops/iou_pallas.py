@@ -27,9 +27,10 @@ are ``(1, TN)`` with ``TN`` a multiple of 128.
 implicit lane padding the TPU lowering does not guarantee — ADVICE
 round 1.)
 
-The top-D merge is D unrolled select-max passes on the VPU (no sort,
-no lax.top_k): each pass takes the row max, extracts its index with a
-one-hot reduction, and masks it out.  All ops are elementwise or
+The top-D merge is D select-max passes on the VPU (no sort, no
+lax.top_k), run as a ``fori_loop`` with the workspace in the carry:
+each pass takes the row max, extracts its index with a one-hot
+reduction, and masks it out.  All ops are elementwise or
 row-reductions — exactly what the 8x128 VPU wants.
 
 Used by :func:`pallas_topk_neighbors`, a drop-in for the dense path's
@@ -50,9 +51,10 @@ from jax.experimental import pallas as pl
 
 NEG = -1.0  # sentinel value for empty top-D slots (any IoU is >= 0)
 LANE = 128  # TPU lane width; all trailing block dims align to this
-# Fail-fast ceiling for direct callers: the merge is d unrolled
-# passes, so a runaway d buys minutes of trace/compile, not a better
-# kernel.  enumerate_cliques applies its own (lower) escalation cap.
+# Fail-fast ceiling for direct callers: the merge is d sequential
+# select-max passes, so a runaway d buys a slow kernel (serial VPU
+# work linear in d), not a better one.  enumerate_cliques applies its
+# own (lower) escalation cap.
 MAX_D = 1024
 
 
@@ -105,19 +107,30 @@ def _neighbor_kernel(
     )
     cnt = ti_ref[:, d : d + 1] + tile_cnt            # (TM, 1)
 
-    # Merge this tile into the running top-D: D unrolled
-    # select-max-and-mask passes over the (TM, D + TN) workspace.
+    # Merge this tile into the running top-D: d select-max-and-mask
+    # passes over the (TM, D + TN) workspace, as a fori_loop with the
+    # workspace in the carry.  A Python-level unrolled loop here
+    # stack-allocates every pass's intermediates SIMULTANEOUSLY
+    # (Mosaic scoped-vmem OOM on the real chip: 24.5 MB vs the 16 MB
+    # VMEM budget at d=16, TM=256, TN=512); the carried loop caps
+    # liveness at ~2 workspace buffers independent of d.
     cand_idx = j * tn + jax.lax.broadcasted_iota(
         jnp.int32, iou.shape, 1
     )
-    work_v = jnp.concatenate([tv_ref[:, :d], iou], axis=1)
+    work_v0 = jnp.concatenate([tv_ref[:, :d], iou], axis=1)
+    # work_i is loop-INVARIANT (only work_v is masked between passes;
+    # positions never move) — close over it rather than carrying it,
+    # saving a (TM, D+TN) int32 loop buffer of scoped-VMEM liveness.
     work_i = jnp.concatenate(
         [ti_ref[:, :d], cand_idx.astype(jnp.int32)], axis=1
     )
-    pos = jax.lax.broadcasted_iota(jnp.int32, work_v.shape, 1)
-    new_v = []
-    new_i = []
-    for s in range(d):
+    pos = jax.lax.broadcasted_iota(jnp.int32, work_v0.shape, 1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (tm, w), 1)
+    out_v0 = jnp.full((tm, w), NEG, tv_ref.dtype)
+    out_i0 = jnp.full((tm, w), m_total, jnp.int32)
+
+    def _pass(s, carry):
+        work_v, out_v, out_i = carry
         row_max = jnp.max(work_v, axis=1, keepdims=True)   # (TM, 1)
         # first position among the row maxima — explicit min-reduction
         # rather than argmax: Mosaic's argmax tie-break differs from
@@ -136,14 +149,16 @@ def _neighbor_kernel(
         picked_i = jnp.where(
             row_max > NEG, picked_i, jnp.int32(m_total)
         )
-        new_v.append(row_max)
-        new_i.append(picked_i)
+        out_v = jnp.where(lane == s, row_max, out_v)
+        out_i = jnp.where(lane == s, picked_i, out_i)
         work_v = jnp.where(sel, NEG, work_v)
-    new_v.append(jnp.full((tm, w - d), NEG, tv_ref.dtype))
-    new_i.append(cnt)  # the count rides in lane d
-    new_i.append(jnp.full((tm, w - d - 1), m_total, jnp.int32))
-    tv_ref[:] = jnp.concatenate(new_v, axis=1)
-    ti_ref[:] = jnp.concatenate(new_i, axis=1)
+        return work_v, out_v, out_i
+
+    _, out_v, out_i = jax.lax.fori_loop(
+        0, d, _pass, (work_v0, out_v0, out_i0)
+    )
+    tv_ref[:] = out_v
+    ti_ref[:] = jnp.where(lane == d, cnt, out_i)  # count rides lane d
 
 
 @functools.partial(
@@ -184,12 +199,12 @@ def pallas_topk_neighbors(
     # State width: as many 128-lane blocks as d+1 (top-D + the
     # adjacency count in lane d) needs.  d < 128 keeps the original
     # single-block layout; larger d widens the revisited output block
-    # instead of falling back to the XLA matrix path.  The merge is d
-    # unrolled passes, so compile time and VPU work grow with d —
-    # enumerate_cliques caps its escalation use accordingly.
+    # instead of falling back to the XLA matrix path.  The merge runs
+    # d sequential select-max passes, so serial VPU work grows with
+    # d — enumerate_cliques caps its escalation use accordingly.
     if d > MAX_D:
         raise ValueError(
-            f"d={d} exceeds MAX_D={MAX_D}: the merge unrolls d "
+            f"d={d} exceeds MAX_D={MAX_D}: the merge runs d serial "
             "select-max passes; use the XLA matrix path instead"
         )
     w = -(-(d + 1) // LANE) * LANE

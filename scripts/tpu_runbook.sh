@@ -75,8 +75,14 @@ all_captured() {
 
 # Run one runbook step under a timeout, writing stdout to an artifact.
 # Skips the step if the artifact was already captured on-TPU (resume
-# after a mid-sequence wedge).  Returns non-zero if the step
-# failed/hung so the caller can resume probing.
+# after a mid-sequence wedge).  Return codes:
+#   0 — artifact captured (or already present)
+#   1 — hung/timed out: the tunnel is wedging, later steps would hang
+#       too, caller should return to probing
+#   2 — fast failure (crash / CPU fallback): the tunnel is answering,
+#       the step itself is broken — caller should CONTINUE to the next
+#       step so one buggy bench doesn't forfeit the rest of an open
+#       window (exactly what the round-5 Pallas vmem OOM did cost us)
 step() {
     local name="$1" timeout_s="$2" out="$3"; shift 3
     if captured "$out"; then
@@ -86,8 +92,11 @@ step() {
     # lock fds are NOT passed down (8>&- 9>&-): an orphaned child must
     # never keep holding the watcher's locks after the watcher dies
     say "step $name: starting (timeout ${timeout_s}s): $*"
-    if timeout -k 10 "$timeout_s" "$@" >"$out.tmp" 2>>"$LOG" </dev/null \
-        8>&- 9>&-; then
+    timeout -k 10 "$timeout_s" "$@" >"$out.tmp" 2>>"$LOG" </dev/null 8>&- 9>&-
+    local rc=$?   # must be captured HERE: $? after an if-statement whose
+                  # condition failed is the if's own status (0), not the
+                  # command's — the round-5 log's "FAILED rc=0"
+    if [ "$rc" -eq 0 ]; then
         # Exit 0 is not enough: if the tunnel dropped between probe and
         # step, JAX silently falls back to CPU and the step "succeeds"
         # with CPU numbers — refuse to file those under a TPU artifact.
@@ -99,12 +108,18 @@ step() {
         fi
         say "step $name: ran but not on TPU (backend fell back); discarding"
         mv "$out.tmp" "$out.partial"
+        # A CPU fallback means the tunnel itself is gone — every later
+        # step would also fall back and be discarded; return to probing
+        # instead of burning the window on doomed runs.
         return 1
     fi
-    local rc=$?
-    say "step $name: FAILED rc=$rc (124 = hung/timed out; tunnel likely re-wedged)"
     [ -s "$out.tmp" ] && mv "$out.tmp" "$out.partial"
-    return 1
+    if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+        say "step $name: HUNG rc=$rc (timed out; tunnel likely re-wedged)"
+        return 1
+    fi
+    say "step $name: FAILED rc=$rc (fast failure; tunnel alive, continuing)"
+    return 2
 }
 
 runbook() {
@@ -116,14 +131,25 @@ runbook() {
     # fastest path to the headline number while the window is open; the
     # full bench.py CPU-first protocol is for driver runs, not chip
     # windows that may close in minutes.
-    step headline 600 "$BENCH_OUT" "$PY" bench.py --child || return 1
-    step pallas 1200 "$PALLAS_OUT" "$PY" bench_pallas.py || return 1
+    # Evidence first, experiment last: the breakdown rows are the
+    # framework's TPU-vs-CPU case; the Pallas head-to-head is an
+    # optimization decision.  A fast step failure (rc 2) moves on to
+    # the next step; only a hang (rc 1) aborts back to probing.
+    local rc=0 incomplete=0
+    step headline 600 "$BENCH_OUT" "$PY" bench.py --child; rc=$?
+    [ "$rc" -eq 1 ] && return 1; [ "$rc" -ne 0 ] && incomplete=1
     step bd_headline 900 "$BD_HEADLINE_OUT" "$PY" bench_breakdown.py \
-        --workloads headline || return 1
+        --workloads headline; rc=$?
+    [ "$rc" -eq 1 ] && return 1; [ "$rc" -ne 0 ] && incomplete=1
     step bd_stress 1200 "$BD_STRESS_OUT" "$PY" bench_breakdown.py \
-        --workloads stress || return 1
+        --workloads stress; rc=$?
+    [ "$rc" -eq 1 ] && return 1; [ "$rc" -ne 0 ] && incomplete=1
     step bd_batch1024 2400 "$BD_1024_OUT" "$PY" bench_breakdown.py \
-        --workloads batch1024 || return 1
+        --workloads batch1024; rc=$?
+    [ "$rc" -eq 1 ] && return 1; [ "$rc" -ne 0 ] && incomplete=1
+    step pallas 1200 "$PALLAS_OUT" "$PY" bench_pallas.py; rc=$?
+    [ "$rc" -eq 1 ] && return 1; [ "$rc" -ne 0 ] && incomplete=1
+    [ "$incomplete" -ne 0 ] && return 1
     # Refresh the last-healthy-TPU sidecar from the fresh headline so a
     # later wedged bench.py run degrades to this session's number.
     # Reuses bench.py's writer (schema + error handling live there).
@@ -164,7 +190,7 @@ while :; do
     # in-flight measurement.
     if ! flock -n 9; then
         say "probe $n skipped: chip lock held by another process"
-        sleep "$PROBE_INTERVAL"
+        sleep "$PROBE_INTERVAL" 8>&- 9>&-
         continue
     fi
     if probe; then
@@ -178,5 +204,5 @@ while :; do
         say "probe $n unhealthy"
     fi
     flock -u 9
-    sleep "$PROBE_INTERVAL"
+    sleep "$PROBE_INTERVAL" 8>&- 9>&-
 done
