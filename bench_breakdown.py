@@ -23,8 +23,11 @@ Methodology notes:
 - ``jax.block_until_ready`` is a no-op on this platform (tunneled
   chip), so all timing is fetch-based: a measurement ends when a
   result array materializes on the host.
-- Device time is isolated as (execute+fetch) - (re-fetch of the same
-  already-computed array): the second fetch pays only D2H + RTT.
+- Device time is isolated by amortization: a chain of back-to-back
+  dispatches pays the dispatch round trip once, so the marginal
+  per-execution time ``(t_chain - t_single)/(chain-1)`` excludes it.
+  (A re-fetch of an already-fetched array is NOT a usable transfer
+  baseline: jax.Array caches its host copy, making it a no-op.)
 - The dispatch round-trip (RTT) is measured with a trivial jitted
   op and reported so tunnel latency is visible, not inferred.
 - FLOP and HBM-byte figures come from XLA's own cost model
@@ -50,6 +53,21 @@ import numpy as np
 PEAK_HBM_GBPS = 819.0  # nominal v5e HBM bandwidth, for context
 
 
+def _progress(msg: str) -> None:
+    """Timestamped stage marker on stderr (flushed immediately).
+
+    The TPU runbook runs these benches under a hard timeout over a
+    tunnel that can wedge mid-run; the markers land in the watcher log
+    so a killed run shows WHICH stage (synthesize / compile+first-call
+    / isolation) it died in instead of 20 silent minutes.
+    """
+    print(
+        time.strftime("%H:%M:%S", time.gmtime()) + f" [bd] {msg}",
+        file=sys.stderr,
+        flush=True,
+    )
+
+
 def _rtt_seconds(reps: int = 30) -> float:
     """Median dispatch+fetch round trip of a trivial jitted op."""
     import jax
@@ -66,26 +84,43 @@ def _rtt_seconds(reps: int = 30) -> float:
     return float(np.median(ts))
 
 
-def _device_isolation(fn, args, fetch_field="picked", reps: int = 5):
-    """(execute+fetch, refetch-only) medians for a jitted consensus fn.
+def _device_isolation(
+    fn, args, fetch_field="picked", reps: int = 5, chain: int = 4
+):
+    """(single execute+fetch, marginal per-execution) medians.
 
-    The first timing dispatches the whole program and fetches one
-    output; the second fetches the same, already-computed array —
-    paying only transfer + RTT.  Their difference isolates device
-    execution."""
+    ``single``: dispatch the program once and fetch one output —
+    includes the dispatch round trip, so over a tunneled chip it is an
+    UPPER BOUND on device time.  ``marginal``: dispatch ``chain``
+    back-to-back executions and fetch only the last; the fixed
+    dispatch+fetch cost is paid once, so
+    ``(t_chain - t_single) / (chain - 1)`` is the per-execution device
+    time with the round trip amortized away.
+
+    (An earlier version timed a re-fetch of an already-fetched array
+    as the transfer baseline — but jax.Array caches its host copy, so
+    that second fetch is a no-op and the "isolated" device time
+    silently kept the full tunnel RTT.  The committed
+    BREAKDOWN_TPU_r5_headline.jsonl shows it: refetch 6e-05 s vs a
+    measured 0.076 s dispatch RTT.)"""
     res = fn(*args)
-    first = np.asarray(getattr(res, fetch_field))  # warm-up + compile
-    exec_ts, fetch_ts = [], []
+    np.asarray(getattr(res, fetch_field))  # warm-up + compile
+    single_ts, chain_ts = [], []
     for _ in range(reps):
         t0 = time.time()
         res = fn(*args)
         np.asarray(getattr(res, fetch_field))
-        exec_ts.append(time.time() - t0)
+        single_ts.append(time.time() - t0)
         t0 = time.time()
+        for _ in range(chain):
+            res = fn(*args)
         np.asarray(getattr(res, fetch_field))
-        fetch_ts.append(time.time() - t0)
-    del first
-    return float(np.median(exec_ts)), float(np.median(fetch_ts))
+        chain_ts.append(time.time() - t0)
+    single = float(np.median(single_ts))
+    marginal = max(
+        (float(np.median(chain_ts)) - single) / (chain - 1), 0.0
+    )
+    return single, marginal
 
 
 def _cost_analysis(fn, args):
@@ -139,23 +174,35 @@ def bench_headline(platform: str) -> dict:
         (n, box_io.load_micrograph_set(data, pickers, n)) for n in names
     ]
     batch = pad_batch([(n, s) for n, s in loaded if s is not None])
-    # seed the capacity config, then time the compiled fn directly
+    # seed the capacity config, then time the compiled fn directly.
+    # Filter the lookup on the FULL cache key: with configs persisted
+    # across processes, a same-shape entry from a different
+    # threshold/spatial workload could otherwise be matched here and
+    # the isolated timing would compile a different program than the
+    # end-to-end pass it decomposes.
     run_consensus_batch(batch, 180.0, use_mesh=False)
-    from repic_tpu.pipeline.consensus import last_good_config
+    from repic_tpu.pipeline.consensus import (
+        DEFAULT_THRESHOLD,
+        last_good_config,
+    )
 
-    (d, cap, cell_cap) = last_good_config(batch.xy.shape)[:3]
+    (d, cap, cell_cap) = last_good_config(
+        batch.xy.shape,
+        spatial=False,
+        sizes=(180.0,),
+        threshold=DEFAULT_THRESHOLD,
+    )[:3]
     fn = make_batched_consensus(
         max_neighbors=d, clique_capacity=cap, mesh=None
     )
     xy = jax.device_put(batch.xy)
     conf = jax.device_put(batch.conf)
     mask = jax.device_put(batch.mask)
-    exec_s, fetch_s = _device_isolation(
+    single_s, device_s = _device_isolation(
         fn, (xy, conf, mask, 180.0)
     )
     flops, bytes_ = _cost_analysis(fn, (xy, conf, mask, 180.0))
     rtt = _rtt_seconds()
-    device_s = max(exec_s - fetch_s, 0.0)
     return {
         "workload": "headline (12 micrographs, 3 pickers, box 180)",
         "platform": platform,
@@ -166,8 +213,7 @@ def bench_headline(platform: str) -> dict:
         "rate_micrographs_per_s": round(
             stats["micrographs"] / stats["total_s"], 2
         ),
-        "device_exec_plus_fetch_s": round(exec_s, 5),
-        "refetch_only_s": round(fetch_s, 5),
+        "device_exec_plus_fetch_s": round(single_s, 5),
         "device_exec_s": round(device_s, 5),
         "dispatch_rtt_s": round(rtt, 5),
         "xla_flops": flops,
@@ -219,14 +265,18 @@ def bench_batch1024(platform: str, m: int = 1024, n_per: int = 700):
     data = tempfile.mkdtemp(prefix="repic_bd_1024_")
     out = tempfile.mkdtemp(prefix="repic_bd_1024_out_")
     try:
+        _progress(f"batch1024: synthesizing {m} micrograph BOX tree")
         t0 = time.time()
         synth_box_tree(data, m, 5, n_per, MIXED_SIZES)
         synth_s = time.time() - t0
         sizes = np.asarray(MIXED_SIZES, np.float32)
+        _progress("batch1024: warm pass (compile + capacity probe)")
         run_consensus_dir(  # warm: compile + capacity probe
             data, out, sizes, use_mesh=False
         )
+        _progress("batch1024: measured pass")
         stats = run_consensus_dir(data, out, sizes, use_mesh=False)
+        _progress("batch1024: measured pass done")
         return {
             "workload": (
                 f"configs[4]: k=5 mixed box sizes, {m} micrographs, "
@@ -264,6 +314,7 @@ def bench_stress(platform: str, m: int = 4, n: int = 50_000, k: int = 4):
     )
     from repic_tpu.ops.spatial import grid_size
 
+    _progress(f"stress: synthesizing {m}x{k}x{n}")
     xy, conf, mask = synthesize(m, k, n)
     batch = PaddedBatch(
         xy=xy,
@@ -272,13 +323,24 @@ def bench_stress(platform: str, m: int = 4, n: int = 50_000, k: int = 4):
         names=tuple(f"m{i}" for i in range(m)),
         counts=np.full((m, k), n, np.int32),
     )
+    _progress("stress: first run_consensus_batch (probe + compile)")
     t0 = time.time()
     res = run_consensus_batch(batch, 180.0, use_mesh=False)
     np.asarray(res.picked)
     first_s = time.time() - t0
+    _progress(f"stress: first call done in {first_s:.1f}s; isolating")
 
-    # recover the probed capacities and grid for direct timing
-    d, cap, cell_cap = last_good_config(batch.xy.shape, spatial=True)[:3]
+    # recover the probed capacities and grid for direct timing (full
+    # cache-key filter: persisted same-shape configs from other
+    # workloads must not leak in)
+    from repic_tpu.pipeline.consensus import DEFAULT_THRESHOLD
+
+    d, cap, cell_cap = last_good_config(
+        batch.xy.shape,
+        spatial=True,
+        sizes=(180.0,),
+        threshold=DEFAULT_THRESHOLD,
+    )[:3]
     extent = float(np.max(batch.xy)) + 180.0
     grid = grid_size(extent, 180.0)
     fn = make_batched_consensus(
@@ -297,10 +359,11 @@ def bench_stress(platform: str, m: int = 4, n: int = 50_000, k: int = 4):
     )
     np.asarray(dev_args[0])  # h2d fence (fetch-based: RTT-bounded)
     h2d_s = time.time() - t0
-    exec_s, fetch_s = _device_isolation(fn, dev_args, reps=3)
+    _progress("stress: device isolation (3 reps)")
+    single_s, device_s = _device_isolation(fn, dev_args, reps=3)
+    _progress("stress: cost analysis")
     flops, bytes_ = _cost_analysis(fn, dev_args)
     rtt = _rtt_seconds()
-    device_s = max(exec_s - fetch_s, 0.0)
     return {
         "workload": (
             f"stress configs[3]: {n} particles x {k} pickers, "
@@ -309,11 +372,10 @@ def bench_stress(platform: str, m: int = 4, n: int = 50_000, k: int = 4):
         "platform": platform,
         "first_call_s": round(first_s, 2),
         "h2d_upper_bound_s": round(h2d_s, 4),
-        "device_exec_plus_fetch_s": round(exec_s, 4),
-        "refetch_only_s": round(fetch_s, 4),
+        "device_exec_plus_fetch_s": round(single_s, 4),
         "device_exec_s": round(device_s, 4),
         "dispatch_rtt_s": round(rtt, 5),
-        "rate_micrographs_per_s": round(m / exec_s, 3),
+        "rate_micrographs_per_s": round(m / single_s, 3),
         "device_only_rate": round(m / device_s, 3)
         if device_s > 0
         else None,
@@ -347,15 +409,19 @@ def main():
     ap.add_argument("--stress_n", type=int, default=50_000)
     args = ap.parse_args()
 
-    from bench import hold_chip_lock
-
-    _chip = hold_chip_lock()  # quiet the TPU watcher during timing
-
     if args.cpu:
+        # CPU run: never touches the chip, so do NOT contend for the
+        # chip lock — the TPU watcher holds it for up to ~75 s per
+        # probe cycle and a CPU measurement would stall behind it.
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        _chip = None
+    else:
+        from bench import hold_chip_lock
+
+        _chip = hold_chip_lock()  # quiet the TPU watcher during timing
     import jax
 
     platform = jax.devices()[0].platform

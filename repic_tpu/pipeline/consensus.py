@@ -314,6 +314,118 @@ def _make_spatial_probe(grid: int, cell_capacity: int, threshold: float):
 # three chunks exhibit, and demotes again when large chunks stop.
 _LAST_GOOD_CONFIG: dict = {}
 _RECENT_REQUIREMENTS: dict = {}
+_CONFIG_CACHE_LOADED = False
+
+
+def _config_cache_path():
+    """Sidecar file persisting accepted capacity configs across
+    processes, next to the XLA compile cache.
+
+    Motivation mirrors the compile cache itself: every capacity probe
+    is 1-2 extra compiles, and over a tunneled TPU (remote compile,
+    windows measured in minutes) a fresh process re-paying probes it
+    already ran last invocation is pure waste.  The persisted config
+    is a starting point, not an oracle — the overflow-escalation loop
+    still corrects any underestimate at the cost of one re-run, the
+    same contract as in-process reuse.  Opt out with
+    ``REPIC_TPU_NO_CACHE=1`` (everything) or
+    ``REPIC_TPU_NO_CONFIG_CACHE=1`` (configs only; the test suite
+    sets this so runs stay order-independent).
+    """
+    if os.environ.get("REPIC_TPU_NO_CACHE") or os.environ.get(
+        "REPIC_TPU_NO_CONFIG_CACHE"
+    ):
+        return None
+    return os.path.join(
+        os.path.expanduser("~"),
+        ".cache",
+        "repic_tpu",
+        "capacity_configs.json",
+    )
+
+
+def _load_persisted_configs():
+    """Populate ``_LAST_GOOD_CONFIG`` from the sidecar, once.
+
+    In-process records win over persisted ones (they are fresher).
+    Corrupt or unreadable sidecars are ignored — the cache is an
+    optimization, never a correctness dependency.
+    """
+    global _CONFIG_CACHE_LOADED
+    if _CONFIG_CACHE_LOADED:
+        return
+    _CONFIG_CACHE_LOADED = True
+    path = _config_cache_path()
+    if path is None:
+        return
+    import json
+
+    try:
+        with open(path) as f:
+            entries = json.load(f)
+        for e in entries:
+            shape, sizes, threshold, spatial = e["key"]
+            key = (
+                tuple(shape),
+                tuple(sizes),
+                float(threshold),
+                bool(spatial),
+            )
+            _LAST_GOOD_CONFIG.setdefault(key, tuple(e["cfg"]))
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+
+
+_LAST_PERSISTED: dict = {}
+
+
+def _persist_config(cfg_key, cfg) -> None:
+    """Write-through one accepted config (atomic replace, last-64).
+
+    Skips the disk round-trip when this process already persisted the
+    same value for the key — run_consensus_dir records once per chunk
+    and the lower-median config converges after ~3 chunks, so without
+    this check a 1024-micrograph run rewrites an unchanged sidecar
+    dozens of times.  Best-effort like the compile cache: ANY failure
+    (corrupt sidecar of the wrong JSON shape included) is swallowed —
+    persistence must never take down a computed result.
+    """
+    if _LAST_PERSISTED.get(cfg_key) == tuple(cfg):
+        return
+    path = _config_cache_path()
+    if path is None:
+        return
+    import json
+
+    try:
+        entries = []
+        try:
+            with open(path) as f:
+                loaded = json.load(f)
+            if isinstance(loaded, list):
+                entries = [
+                    e for e in loaded
+                    if isinstance(e, dict) and "key" in e
+                ]
+        except (OSError, ValueError):
+            pass
+        ser_key = [
+            list(cfg_key[0]),
+            list(cfg_key[1]),
+            cfg_key[2],
+            cfg_key[3],
+        ]
+        entries = [e for e in entries if e.get("key") != ser_key]
+        entries.append({"key": ser_key, "cfg": list(cfg)})
+        del entries[:-64]
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "wt") as f:
+            json.dump(entries, f)
+        os.replace(tmp, path)
+        _LAST_PERSISTED[cfg_key] = tuple(cfg)
+    except (OSError, ValueError, TypeError):
+        pass
 
 
 def last_good_config(
@@ -454,6 +566,7 @@ def run_consensus_batch(
         threshold,
         bool(spatial),
     )
+    _load_persisted_configs()
     known = _LAST_GOOD_CONFIG.get(cfg_key)
     if spatial:
         from repic_tpu.ops.spatial import grid_size
@@ -541,6 +654,7 @@ def run_consensus_batch(
             # record what this call executed: the next same-shape call
             # reuses its cached executable with zero compile cost
             _LAST_GOOD_CONFIG[cfg_key] = (d, cap, cell_cap, pcap)
+            _persist_config(cfg_key, (d, cap, cell_cap, pcap))
             return res
         # lower-median requirement TUPLE of the last <=3 (ordered by a
         # total-work proxy): robust to one outlier, follows two of
@@ -549,7 +663,9 @@ def run_consensus_batch(
         by_cost = sorted(
             recent, key=lambda r: (r[0] * r[1] * r[2] * r[3], r)
         )
-        _LAST_GOOD_CONFIG[cfg_key] = by_cost[(len(recent) - 1) // 2]
+        chosen = by_cost[(len(recent) - 1) // 2]
+        _LAST_GOOD_CONFIG[cfg_key] = chosen
+        _persist_config(cfg_key, chosen)
         return res
 
 

@@ -14,6 +14,10 @@ _USE_REAL_TPU = os.environ.get("REPIC_TPU_TEST_TPU") == "1"
 
 if not _USE_REAL_TPU:
     os.environ["JAX_PLATFORMS"] = "cpu"
+# Tests must not read or write the user's persisted capacity-config
+# sidecar: recorded configs would leak across runs and make capacity
+# assertions order/history-dependent.
+os.environ["REPIC_TPU_NO_CONFIG_CACHE"] = "1"
 _flags = os.environ.get("XLA_FLAGS", "")
 if (
     not _USE_REAL_TPU

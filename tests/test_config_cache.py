@@ -1,0 +1,137 @@
+"""Cross-process persistence of accepted capacity configs.
+
+The capacity probe costs 1-2 extra XLA compiles per workload shape;
+`pipeline/consensus.py` persists each accepted
+(max_neighbors, clique_capacity, cell_capacity, partial_capacity)
+tuple to a JSON sidecar so a FRESH process (bench retry inside a TPU
+window, a user relaunching the CLI) starts from the recorded config
+instead of re-paying the probes.  The conftest sets
+REPIC_TPU_NO_CONFIG_CACHE=1 so the suite never touches the user's real
+sidecar; these tests point HOME at a tmpdir and re-enable it.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from repic_tpu.parallel.batching import pad_batch
+from repic_tpu.pipeline import consensus as C
+from repic_tpu.utils.box_io import BoxSet
+
+
+def _run_once(tmp_home, monkeypatch, seed=7):
+    monkeypatch.setenv("HOME", str(tmp_home))
+    monkeypatch.delenv("REPIC_TPU_NO_CONFIG_CACHE", raising=False)
+    rng = np.random.default_rng(seed)
+    mics = []
+    for i in range(2):
+        pickers = []
+        for _ in range(3):
+            n = 40
+            xy = rng.uniform(0, 2000, size=(n, 2)).astype(np.float32)
+            conf = rng.uniform(0.1, 1.0, size=(n,)).astype(np.float32)
+            wh = np.full((n, 2), 180.0, np.float32)
+            pickers.append(BoxSet(xy=xy, conf=conf, wh=wh))
+        mics.append((f"m{i}", pickers))
+    batch = pad_batch(mics)
+    return C.run_consensus_batch(batch, 180.0, use_mesh=False)
+
+
+@pytest.fixture
+def clean_config_state():
+    """Snapshot and restore the module-level config caches."""
+    saved = (
+        dict(C._LAST_GOOD_CONFIG),
+        {k: list(v) for k, v in C._RECENT_REQUIREMENTS.items()},
+        C._CONFIG_CACHE_LOADED,
+        dict(C._LAST_PERSISTED),
+    )
+    # start each test from clean module state (the write-skip memo in
+    # particular would otherwise suppress rewrites across params)
+    C._RECENT_REQUIREMENTS.clear()
+    C._LAST_PERSISTED.clear()
+    yield
+    C._LAST_GOOD_CONFIG.clear()
+    C._LAST_GOOD_CONFIG.update(saved[0])
+    C._RECENT_REQUIREMENTS.clear()
+    C._RECENT_REQUIREMENTS.update(saved[1])
+    C._CONFIG_CACHE_LOADED = saved[2]
+    C._LAST_PERSISTED.clear()
+    C._LAST_PERSISTED.update(saved[3])
+
+
+def test_sidecar_written_and_reloaded(
+    tmp_path, monkeypatch, clean_config_state
+):
+    _run_once(tmp_path, monkeypatch)
+    path = os.path.join(
+        str(tmp_path), ".cache", "repic_tpu", "capacity_configs.json"
+    )
+    assert os.path.exists(path)
+    entries = json.load(open(path))
+    assert len(entries) >= 1
+    # every persisted entry mirrors the in-process record
+    for e in entries:
+        shape, sizes, threshold, spatial = e["key"]
+        key = (
+            tuple(shape), tuple(sizes), float(threshold), bool(spatial)
+        )
+        if key in C._LAST_GOOD_CONFIG:
+            assert tuple(e["cfg"]) == C._LAST_GOOD_CONFIG[key]
+
+    # simulate a fresh process: wipe in-memory state, reload lazily.
+    # Only the SIDECAR's entries come back — in-suite, _LAST_GOOD_CONFIG
+    # also holds configs other test files recorded while persistence
+    # was disabled, and those are (correctly) gone after a reload.
+    C._LAST_GOOD_CONFIG.clear()
+    C._RECENT_REQUIREMENTS.clear()
+    C._CONFIG_CACHE_LOADED = False
+    C._load_persisted_configs()
+    for e in entries:
+        shape, sizes, threshold, spatial = e["key"]
+        key = (
+            tuple(shape), tuple(sizes), float(threshold), bool(spatial)
+        )
+        assert C._LAST_GOOD_CONFIG.get(key) == tuple(e["cfg"])
+
+
+@pytest.mark.parametrize(
+    "garbage",
+    ["{not json", "{}", "[1, 2]", '[{"nokey": 1}]', '"a string"'],
+)
+def test_corrupt_sidecar_is_ignored(
+    tmp_path, monkeypatch, clean_config_state, garbage
+):
+    """Corruption of ANY JSON shape is tolerated on load and persist:
+    valid-but-wrong-shape sidecars ({}, [1,2], entries without 'key')
+    must neither crash the consensus call nor poison the rewrite."""
+    cache_dir = tmp_path / ".cache" / "repic_tpu"
+    cache_dir.mkdir(parents=True)
+    (cache_dir / "capacity_configs.json").write_text(garbage)
+    monkeypatch.setenv("HOME", str(tmp_path))
+    monkeypatch.delenv("REPIC_TPU_NO_CONFIG_CACHE", raising=False)
+    C._LAST_GOOD_CONFIG.clear()
+    C._CONFIG_CACHE_LOADED = False
+    C._load_persisted_configs()  # must not raise
+    assert C._CONFIG_CACHE_LOADED
+    # and a run still works + rewrites a valid sidecar
+    res = _run_once(tmp_path, monkeypatch)
+    assert res is not None
+    entries = json.load(open(cache_dir / "capacity_configs.json"))
+    assert isinstance(entries, list) and entries
+    assert all(isinstance(e, dict) and "key" in e for e in entries)
+
+
+def test_opt_outs_disable_persistence(
+    tmp_path, monkeypatch, clean_config_state
+):
+    monkeypatch.setenv("HOME", str(tmp_path))
+    monkeypatch.setenv("REPIC_TPU_NO_CONFIG_CACHE", "1")
+    assert C._config_cache_path() is None
+    monkeypatch.delenv("REPIC_TPU_NO_CONFIG_CACHE")
+    monkeypatch.setenv("REPIC_TPU_NO_CACHE", "1")
+    assert C._config_cache_path() is None
+    monkeypatch.delenv("REPIC_TPU_NO_CACHE")
+    assert C._config_cache_path() is not None
