@@ -141,10 +141,10 @@ runbook() {
     step bd_headline 900 "$BD_HEADLINE_OUT" "$PY" bench_breakdown.py \
         --workloads headline; rc=$?
     [ "$rc" -eq 1 ] && return 1; [ "$rc" -ne 0 ] && incomplete=1
-    step bd_stress 1200 "$BD_STRESS_OUT" "$PY" bench_breakdown.py \
+    step bd_stress 2400 "$BD_STRESS_OUT" "$PY" bench_breakdown.py \
         --workloads stress; rc=$?
     [ "$rc" -eq 1 ] && return 1; [ "$rc" -ne 0 ] && incomplete=1
-    step bd_batch1024 2400 "$BD_1024_OUT" "$PY" bench_breakdown.py \
+    step bd_batch1024 3600 "$BD_1024_OUT" "$PY" bench_breakdown.py \
         --workloads batch1024; rc=$?
     [ "$rc" -eq 1 ] && return 1; [ "$rc" -ne 0 ] && incomplete=1
     step pallas 1200 "$PALLAS_OUT" "$PY" bench_pallas.py; rc=$?
