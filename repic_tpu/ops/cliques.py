@@ -617,16 +617,23 @@ def _assemble_cliques_staged(
     )
     members = jnp.stack([anchor, m1s], axis=1)  # (N*D, 2)
     max_partial = jnp.sum(valid).astype(jnp.int32)
-    part = _stream_compact({"members": members, "valid": valid}, cap)
-    members, valid = part["members"], part["valid"]
+    # Intermediate buffers keep their NATURAL static width when it is
+    # already within capacity: compacting N*D = 9k stage-1 rows into a
+    # 32k-slot buffer would hand stage 2 a 3.5x-inflated extension
+    # (slots * D rows of edge validation, mostly dead) — the measured
+    # k=5 batch workload pays ~12% of its enumeration time for it.
+    # Only the FINAL buffer is normalized to the `cap` width contract.
+    if K == 2 or members.shape[0] > cap:
+        part = _stream_compact({"members": members, "valid": valid}, cap)
+        members, valid = part["members"], part["valid"]
 
     # Stages 2..K-1: extend by picker s's candidates, validate cross
     # edges against every previous member, compact.
     for s in range(2, K):
         anchor = members[:, 0]
-        cand = nbr_idx[s - 1][anchor]          # (cap, D)
-        ciou = nbr_iou[s - 1][anchor]          # (cap, D)
-        ext = jnp.repeat(members, D, axis=0)   # (cap*D, s)
+        cand = nbr_idx[s - 1][anchor]          # (slots, D)
+        ciou = nbr_iou[s - 1][anchor]          # (slots, D)
+        ext = jnp.repeat(members, D, axis=0)   # (slots*D, s); slots<=cap
         m_new = cand.reshape(-1)
         in_range = m_new < N
         m_new = jnp.where(in_range, m_new, 0).astype(jnp.int32)
@@ -647,10 +654,16 @@ def _assemble_cliques_staged(
         max_partial = jnp.maximum(
             max_partial, jnp.sum(v).astype(jnp.int32)
         )
-        part = _stream_compact(
-            {"members": members, "valid": v}, cap
-        )
-        members, valid = part["members"], part["valid"]
+        # Compact to the `cap` width only when forced (overflow) or on
+        # the final stage (the output width contract); otherwise the
+        # buffer keeps its natural width for the next extension.
+        if s == K - 1 or members.shape[0] > cap:
+            part = _stream_compact(
+                {"members": members, "valid": v}, cap
+            )
+            members, valid = part["members"], part["valid"]
+        else:
+            valid = v
 
     # Final statistics over the (cap, K) survivors — same formulas as
     # _assemble_block (edges in _edge_pairs order, median confidence,
