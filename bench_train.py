@@ -1,0 +1,157 @@
+#!/usr/bin/env python3
+"""CNN train-step throughput benchmark (builtin DeepPicker-family model).
+
+The consensus benches are gather/VPU/bandwidth workloads; this is the
+framework's MXU workload — the conv stack of the builtin picker
+(`models/cnn.py`, the reference's DeepPicker CNN re-architected in
+Flax, deepModel.py:63-99) driven by the jitted momentum-SGD update
+step from `models/train.py`.  Measures steady-state images/second for
+float32 and bfloat16 compute (master weights stay float32 on both —
+docs/tpu.md, TrainConfig.compute_dtype).
+
+Methodology (tunnel-safe, fetch-based): the update step carries
+params/opt_state forward, so a chain of K dispatched steps is
+serialized by construction; timing K steps and fetching only the final
+loss amortizes the dispatch round trip the way
+bench_breakdown._device_isolation does.  Steady state excludes the
+compile (first step).
+
+Prints one JSON line per compute dtype.  Run by scripts/tpu_runbook.sh
+in any healthy TPU window; `--cpu` gives the single-core reference
+(and skips the chip lock entirely).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def bench_dtype(compute_dtype: str, batch: int, steps: int, arch: str):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from repic_tpu.models.cnn import (
+        PickerCNN,
+        arch_kwargs,
+        compute_dtype as cd,
+    )
+    from repic_tpu.models.train import _make_update_step
+
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=(batch, 64, 64, 1)).astype(np.float32)
+    labels = rng.integers(0, 2, size=(batch,)).astype(np.int32)
+
+    model = PickerCNN(**arch_kwargs(arch), dtype=cd(compute_dtype))
+    tx = optax.sgd(0.01, momentum=0.9)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 1))
+    )["params"]
+    opt_state = tx.init(params)
+    update = _make_update_step(model, tx)
+
+    db = jax.device_put(data)
+    lb = jax.device_put(labels)
+    drng = jax.random.PRNGKey(1)
+
+    t0 = time.time()
+    params, opt_state, loss, _ = update(params, opt_state, db, lb, drng)
+    float(loss)  # fetch: compile + first step
+    first_s = time.time() - t0
+
+    # K-step chain, fetch once at the end; per-step time is the
+    # marginal over a 1-step run so the fixed dispatch round trip and
+    # the final fetch cancel.
+    def chain(k, params, opt_state):
+        t0 = time.time()
+        loss = None
+        for _ in range(k):
+            params, opt_state, loss, _ = update(
+                params, opt_state, db, lb, drng
+            )
+        float(loss)
+        return time.time() - t0, params, opt_state
+
+    t1, params, opt_state = chain(1, params, opt_state)
+    tk, params, opt_state = chain(steps, params, opt_state)
+    step_s = max((tk - t1) / (steps - 1), 1e-9)
+
+    flops = _train_step_flops(update, params, opt_state, db, lb, drng)
+    return {
+        "workload": (
+            f"cnn-train arch={arch} batch={batch} 64x64x1 patches, "
+            "momentum-SGD update step"
+        ),
+        "platform": jax.devices()[0].platform,
+        "compute_dtype": compute_dtype,
+        "first_step_s": round(first_s, 2),
+        "step_s": round(step_s, 5),
+        "imgs_per_s": round(batch / step_s, 1),
+        "xla_flops_per_step": flops,
+        "achieved_tflops": round(flops / step_s / 1e12, 3)
+        if flops
+        else None,
+    }
+
+
+def _train_step_flops(update, params, opt_state, db, lb, drng):
+    try:
+        compiled = update.lower(
+            params, opt_state, db, lb, drng
+        ).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca.get("flops", 0.0))
+    except Exception as e:
+        print(f"cost_analysis unavailable: {e}", file=sys.stderr)
+        return 0.0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument(
+        "--steps", type=int, default=16,
+        help="chain length for the marginal-step timing (min 2)",
+    )
+    ap.add_argument("--arch", default="deep")
+    ap.add_argument(
+        "--dtypes", default="float32,bfloat16",
+        help="comma-separated compute dtypes to measure",
+    )
+    args = ap.parse_args()
+    if args.steps < 2:
+        ap.error("--steps must be >= 2 (marginal over a 1-step run)")
+
+    if args.cpu:
+        # CPU run never touches the chip: skip the chip lock (the TPU
+        # watcher holds it for up to ~75 s per probe cycle).
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        from bench import hold_chip_lock
+
+        # The lock lives while the handle is open — a discarded return
+        # would drop it instantly and let the watcher's probe children
+        # touch the chip mid-measurement.
+        _chip = hold_chip_lock()  # noqa: F841 — held for main's lifetime
+    import jax
+
+    print(f"platform: {jax.devices()[0].platform}", file=sys.stderr,
+          flush=True)
+    for dt in args.dtypes.split(","):
+        row = bench_dtype(dt.strip(), args.batch, args.steps, args.arch)
+        print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,6 +7,7 @@ safe to run by hand).  Reads whichever of
     BENCH_TPU_<tag>.json            headline (bench.py --child line)
     PALLAS_TPU_<tag>.jsonl          kernel-vs-XLA rows (bench_pallas.py)
     BREAKDOWN_TPU_<tag>_{headline,stress,batch1024}.jsonl
+    TRAIN_TPU_<tag>.jsonl           CNN train-step rows (bench_train.py)
 
 exist in the repo root and rewrites the marked auto-generated section
 of docs/tpu.md with a measured-numbers table, leaving the rest of the
@@ -85,6 +86,16 @@ def build_section(tag: str) -> str | None:
                 + (" (" + ", ".join(extras) + ")" if extras else "")
                 + "."
             )
+
+    train = _rows(os.path.join(ROOT, f"TRAIN_TPU_{tag}.jsonl"))
+    for r in train:
+        found = True
+        lines.append(
+            f"* **CNN train ({r.get('compute_dtype')})**: "
+            f"{r.get('imgs_per_s')} imgs/s, "
+            f"{r.get('achieved_tflops')} TFLOP/s achieved "
+            f"(step {r.get('step_s')} s)."
+        )
 
     pallas = _rows(os.path.join(ROOT, f"PALLAS_TPU_{tag}.jsonl"))
     for r in pallas:

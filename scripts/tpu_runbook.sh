@@ -12,7 +12,8 @@
 #
 # Usage:  nohup scripts/tpu_runbook.sh [round_tag] &
 #   round_tag defaults to r5; artifacts land in the repo root as
-#   BENCH_TPU_<tag>.json, PALLAS_TPU_<tag>.jsonl, BREAKDOWN_TPU_<tag>.jsonl
+#   BENCH_TPU_<tag>.json, PALLAS_TPU_<tag>.jsonl,
+#   BREAKDOWN_TPU_<tag>_*.jsonl, TRAIN_TPU_<tag>.jsonl
 #   and the probe/run log appends to docs/tpu_probe_<tag>.log.
 #
 # Contract:
@@ -46,6 +47,7 @@ PALLAS_OUT="PALLAS_TPU_${TAG}.jsonl"
 BD_HEADLINE_OUT="BREAKDOWN_TPU_${TAG}_headline.jsonl"
 BD_STRESS_OUT="BREAKDOWN_TPU_${TAG}_stress.jsonl"
 BD_1024_OUT="BREAKDOWN_TPU_${TAG}_batch1024.jsonl"
+TRAIN_OUT="TRAIN_TPU_${TAG}.jsonl"
 
 mkdir -p docs
 say() { echo "$(date -u '+%Y-%m-%d %H:%M:%S UTC') $*" >>"$LOG"; }
@@ -70,7 +72,7 @@ captured() { [ -s "$1" ] && grep -q '"platform": *"tpu"' "$1"; }
 all_captured() {
     captured "$BENCH_OUT" && captured "$PALLAS_OUT" \
         && captured "$BD_HEADLINE_OUT" && captured "$BD_STRESS_OUT" \
-        && captured "$BD_1024_OUT"
+        && captured "$BD_1024_OUT" && captured "$TRAIN_OUT"
 }
 
 # Run one runbook step under a timeout, writing stdout to an artifact.
@@ -141,6 +143,10 @@ runbook() {
     step bd_headline 900 "$BD_HEADLINE_OUT" "$PY" bench_breakdown.py \
         --workloads headline; rc=$?
     [ "$rc" -eq 1 ] && return 1; [ "$rc" -ne 0 ] && incomplete=1
+    # The MXU workload: small compile, dramatic TPU-vs-CPU ratio —
+    # bank it early in the window.
+    step train 600 "$TRAIN_OUT" "$PY" bench_train.py; rc=$?
+    [ "$rc" -eq 1 ] && return 1; [ "$rc" -ne 0 ] && incomplete=1
     step bd_stress 2400 "$BD_STRESS_OUT" "$PY" bench_breakdown.py \
         --workloads stress; rc=$?
     [ "$rc" -eq 1 ] && return 1; [ "$rc" -ne 0 ] && incomplete=1
@@ -196,7 +202,7 @@ while :; do
     if probe; then
         say "probe $n HEALTHY — running runbook (lock held)"
         if runbook; then
-            say "runbook COMPLETE: $BENCH_OUT $PALLAS_OUT $BD_HEADLINE_OUT $BD_STRESS_OUT $BD_1024_OUT"
+            say "runbook COMPLETE: $BENCH_OUT $PALLAS_OUT $BD_HEADLINE_OUT $BD_STRESS_OUT $BD_1024_OUT $TRAIN_OUT"
             exit 0
         fi
         say "runbook incomplete — resuming probe loop"
