@@ -325,7 +325,10 @@ def bench_stress(platform: str, m: int = 4, n: int = 50_000, k: int = 4):
     )
     _progress("stress: first run_consensus_batch (probe + compile)")
     t0 = time.time()
-    res = run_consensus_batch(batch, 180.0, use_mesh=False)
+    # stress IS the spatial-path bench: force it explicitly so the
+    # config lookup below matches even at smoke-test particle counts
+    # under the auto-spatial threshold
+    res = run_consensus_batch(batch, 180.0, use_mesh=False, spatial=True)
     np.asarray(res.picked)
     first_s = time.time() - t0
     _progress(f"stress: first call done in {first_s:.1f}s; isolating")
@@ -335,12 +338,12 @@ def bench_stress(platform: str, m: int = 4, n: int = 50_000, k: int = 4):
     # workloads must not leak in)
     from repic_tpu.pipeline.consensus import DEFAULT_THRESHOLD
 
-    d, cap, cell_cap = last_good_config(
+    d, cap, cell_cap, pcap = last_good_config(
         batch.xy.shape,
         spatial=True,
         sizes=(180.0,),
         threshold=DEFAULT_THRESHOLD,
-    )[:3]
+    )
     extent = float(np.max(batch.xy)) + 180.0
     grid = grid_size(extent, 180.0)
     fn = make_batched_consensus(
@@ -349,6 +352,9 @@ def bench_stress(platform: str, m: int = 4, n: int = 50_000, k: int = 4):
         mesh=None,
         spatial_grid=grid,
         cell_capacity=cell_cap,
+        # pcap may have escalated above cap: dropping it would time a
+        # SMALLER program than the one whose result was validated
+        partial_capacity=pcap,
     )
     t0 = time.time()
     dev_args = (

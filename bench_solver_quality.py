@@ -148,11 +148,14 @@ def main():
     )
     args = ap.parse_args()
 
-    from bench import hold_chip_lock
+    _chip = None
+    if args.device:
+        from bench import hold_chip_lock
 
-    _chip = hold_chip_lock()  # quiet the TPU watcher during timing
-
+        _chip = hold_chip_lock()  # quiet the TPU watcher during timing
     if not args.device:
+        # CPU run never touches the chip: do NOT contend for the chip
+        # lock (the TPU watcher holds it up to ~75 s per probe cycle)
         import os
 
         os.environ["JAX_PLATFORMS"] = "cpu"

@@ -1,0 +1,92 @@
+"""Smoke tests for the benchmark entry points.
+
+The benches are the TPU runbook's payload: they run unattended inside
+rare healthy chip windows, so an API drift that crashes one (this
+round alone: a 3-tuple unpack of the 4-tuple update step, and a chip
+-lock acquisition stalling CPU runs behind the watcher) burns real
+window time.  Each test runs the bench as a SUBPROCESS — the same way
+the runbook does — on tiny CPU workloads and asserts it emits a
+parseable JSON row with the schema the runbook's `captured()` gate and
+`refresh_tpu_docs.py` consume.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=600):
+    env = dict(os.environ)
+    env["REPIC_TPU_NO_CONFIG_CACHE"] = "1"
+    # each bench forces the CPU backend itself (--cpu here, or
+    # bench_solver_quality's default-CPU mode) and skips the chip lock
+    # on that path, so these tests never contend with the TPU watcher
+    proc = subprocess.run(
+        [sys.executable] + args,
+        cwd=ROOT,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = [
+        json.loads(line)
+        for line in proc.stdout.splitlines()
+        if line.strip().startswith("{")
+    ]
+    assert rows, f"no JSON rows in stdout: {proc.stdout[-500:]}"
+    return rows
+
+
+@pytest.mark.slow
+def test_bench_train_smoke():
+    rows = _run(
+        [
+            "bench_train.py", "--cpu", "--batch", "16", "--steps", "2",
+            "--dtypes", "float32",
+        ]
+    )
+    (row,) = rows
+    assert row["platform"] == "cpu"
+    assert row["compute_dtype"] == "float32"
+    assert row["imgs_per_s"] > 0
+    assert row["step_s"] > 0
+
+
+@pytest.mark.slow
+def test_bench_breakdown_stress_smoke():
+    rows = _run(
+        [
+            "bench_breakdown.py", "--cpu", "--workloads", "stress",
+            "--stress_m", "1", "--stress_n", "512",
+        ]
+    )
+    (row,) = rows
+    assert row["platform"] == "cpu"
+    # the runbook's captured() gate greps for "platform": "tpu" — the
+    # schema key must exist and the device fields must be present
+    for key in (
+        "device_exec_plus_fetch_s",
+        "device_exec_s",
+        "dispatch_rtt_s",
+        "rate_micrographs_per_s",
+    ):
+        assert key in row, key
+
+
+@pytest.mark.slow
+def test_bench_solver_quality_smoke():
+    rows = _run(
+        [
+            "bench_solver_quality.py", "--workloads", "stress",
+            "--m", "1", "--n", "512", "--out", os.devnull,
+        ],
+        timeout=900,
+    )
+    assert rows[-1]["min_jaccard_greedy"] >= 0.9
