@@ -696,14 +696,31 @@ def write_consensus_boxes(
     box_size: int,
     *,
     num_particles: int | None = None,
-) -> dict[str, int]:
-    """Write one consensus BOX file per micrograph."""
+    with_num_cliques: bool = False,
+):
+    """Write one consensus BOX file per micrograph.
+
+    Returns the per-micrograph count dict; with
+    ``with_num_cliques=True`` returns ``(counts, num_cliques)`` with
+    the per-micrograph clique counts fetched in the same transfer.
+    """
     os.makedirs(out_dir, exist_ok=True)
-    # one batched fetch for all four output arrays (per-array fetches
-    # each pay a device round trip — expensive over a tunneled TPU)
-    picked, rep_xy, confidence, rep_slot = jax.device_get(
-        (res.picked, res.rep_xy, res.confidence, res.rep_slot)
+    # ONE device array, ONE fetch: device_get of an N-array tuple
+    # serializes N round trips over the tunneled TPU (measured: the
+    # 4-array write fetch cost ~3x the 76 ms RTT, dominating the
+    # headline end-to-end).  All outputs pack exactly into f32 (bool
+    # picked, int rep_slot < K, int num_cliques < 2^24).
+    packed = np.asarray(
+        _pack_box_outputs(
+            res.picked, res.rep_xy, res.confidence, res.rep_slot,
+            res.num_cliques,
+        )
     )
+    num_cliques = packed[:, 0, 0].astype(np.int64)
+    picked = packed[:, 1:, 0] > 0.5
+    rep_xy = packed[:, 1:, 1:3]
+    confidence = packed[:, 1:, 3]
+    rep_slot = packed[:, 1:, 4].astype(np.int32)
     counts = {}
     for i, name in enumerate(batch.names):
         if not name:
@@ -717,7 +734,31 @@ def write_consensus_boxes(
             box_size,
             num_particles,
         )
+    if with_num_cliques:
+        return counts, num_cliques
     return counts
+
+
+@jax.jit
+def _pack_box_outputs(picked, rep_xy, confidence, rep_slot, num_cliques):
+    """Pack the five BOX-writing outputs into one (M, N+1, 5) f32
+    array so the host pays exactly one device->host transfer."""
+    m = picked.shape[0]
+    core = jnp.concatenate(
+        [
+            picked.astype(jnp.float32)[..., None],
+            rep_xy.astype(jnp.float32),
+            confidence.astype(jnp.float32)[..., None],
+            rep_slot.astype(jnp.float32)[..., None],
+        ],
+        axis=-1,
+    )
+    head = (
+        jnp.zeros((m, 1, 5), jnp.float32)
+        .at[:, 0, 0]
+        .set(jnp.broadcast_to(num_cliques, (m,)).astype(jnp.float32))
+    )
+    return jnp.concatenate([head, core], axis=1)
 
 
 def _cc_keep_mask(member_idx, labels, node_mask):
@@ -1134,15 +1175,17 @@ def run_consensus_dir(
                     num_particles=num_particles,
                 )
             )
+            write_s += time.time() - t2
+            num_cliques += int(np.sum(np.asarray(res.num_cliques)))
         else:
-            counts.update(
-                write_consensus_boxes(
-                    cbatch, res, out_dir, box_size,
-                    num_particles=num_particles,
-                )
+            chunk_counts, chunk_nc = write_consensus_boxes(
+                cbatch, res, out_dir, box_size,
+                num_particles=num_particles,
+                with_num_cliques=True,  # same single packed transfer
             )
-        write_s += time.time() - t2
-        num_cliques += int(np.sum(np.asarray(res.num_cliques)))
+            counts.update(chunk_counts)
+            write_s += time.time() - t2
+            num_cliques += int(chunk_nc.sum())
     timer.stages.append(("compute", compute_s))
     timer.stages.append(("write", write_s))
     timer.write_tsv(out_dir, "consensus_runtime.tsv")

@@ -186,6 +186,22 @@ def test_mixed_size_writer_rows(tmp_path, batch, results):
         batch, dense, str(tmp_path), SIZES
     )
     assert counts and all(v > 0 for v in counts.values())
+    # with_num_cliques rides the SAME packed single-transfer array
+    # (head row, channel 0) — it must round-trip exactly, and the
+    # written files must be byte-identical to the default path
+    before = {
+        name: (tmp_path / f"{name}.box").read_bytes() for name in counts
+    }
+    counts2, nc = write_consensus_boxes(
+        batch, dense, str(tmp_path), SIZES, with_num_cliques=True
+    )
+    assert counts2 == counts
+    assert nc.shape == (batch.xy.shape[0],)
+    np.testing.assert_array_equal(
+        nc, np.asarray(dense.num_cliques).astype(np.int64)
+    )
+    for name in counts:
+        assert (tmp_path / f"{name}.box").read_bytes() == before[name]
     for name in counts:
         rows = [
             line.split("\t")
