@@ -526,8 +526,17 @@ def run_consensus_batch(
     spatial: bool | None = None,
     solver: str = "greedy",
     use_pallas: bool = False,
-) -> ConsensusResult:
+    packed_probe: bool = False,
+) -> "ConsensusResult | tuple[ConsensusResult, np.ndarray]":
     """Run batched consensus on host data with automatic escalation.
+
+    With ``packed_probe=True`` the escalation check fetches the full
+    packed output array (:func:`_pack_box_outputs`) instead of the
+    tiny probe vector, and returns ``(result, packed_host)`` — the
+    BOX-writing path then pays ZERO further device transfers (each
+    fetch is a serialized round trip over a tunneled TPU).  A retried
+    attempt re-fetches, so the extra volume is paid only on the rare
+    escalation.
 
     If the neighbor-list, clique, or bucket capacity overflows (dense
     micrographs), the batch is re-run with doubled capacity — the
@@ -619,13 +628,20 @@ def run_consensus_batch(
         res = fn(xy, conf, mask, box_arg)
         # The four probes are reduced on device and fetched in ONE
         # transfer: per-scalar fetches each pay a full host<->device
-        # round trip (expensive over a tunneled TPU).
-        probes = np.asarray(
-            _probe_reduce(
-                res.max_adjacency, res.num_cliques,
-                res.max_cell_count, res.max_partial,
+        # round trip (expensive over a tunneled TPU).  In packed mode
+        # that one transfer is the full packed output (head row =
+        # per-micrograph probes) so the writer needs no fetch at all.
+        packed = None
+        if packed_probe:
+            packed = _pack_result(res)
+            probes = _packed_probes(packed).max(axis=0)
+        else:
+            probes = np.asarray(
+                _probe_reduce(
+                    res.max_adjacency, res.num_cliques,
+                    res.max_cell_count, res.max_partial,
+                )
             )
-        )
         d, cap, cell_cap, pcap, retry = escalate_capacities(
             probes, d, cap, cell_cap, pcap, has_grid=grid is not None
         )
@@ -655,7 +671,7 @@ def run_consensus_batch(
             # reuses its cached executable with zero compile cost
             _LAST_GOOD_CONFIG[cfg_key] = (d, cap, cell_cap, pcap)
             _persist_config(cfg_key, (d, cap, cell_cap, pcap))
-            return res
+            return (res, packed) if packed_probe else res
         # lower-median requirement TUPLE of the last <=3 (ordered by a
         # total-work proxy): robust to one outlier, follows two of
         # three, demotes when they stop.  A coherent observed tuple —
@@ -666,7 +682,7 @@ def run_consensus_batch(
         chosen = by_cost[(len(recent) - 1) // 2]
         _LAST_GOOD_CONFIG[cfg_key] = chosen
         _persist_config(cfg_key, chosen)
-        return res
+        return (res, packed) if packed_probe else res
 
 
 def _write_box_file(
@@ -697,30 +713,32 @@ def write_consensus_boxes(
     *,
     num_particles: int | None = None,
     with_num_cliques: bool = False,
+    prefetched_packed: np.ndarray | None = None,
 ):
     """Write one consensus BOX file per micrograph.
 
     Returns the per-micrograph count dict; with
     ``with_num_cliques=True`` returns ``(counts, num_cliques)`` with
-    the per-micrograph clique counts fetched in the same transfer.
+    the per-micrograph clique counts read from the same transfer.
+
+    ``prefetched_packed`` accepts the host array a caller already
+    fetched (run_consensus_batch's ``packed_probe`` path reuses its
+    escalation-check fetch) so the chunk pays ZERO additional
+    transfers here.
     """
     os.makedirs(out_dir, exist_ok=True)
     # ONE device array, ONE fetch: device_get of an N-array tuple
     # serializes N round trips over the tunneled TPU (measured: the
     # 4-array write fetch cost ~3x the 76 ms RTT, dominating the
-    # headline end-to-end).  All outputs pack exactly into f32 (bool
-    # picked, int rep_slot < K, int num_cliques < 2^24).
-    packed = np.asarray(
-        _pack_box_outputs(
-            res.picked, res.rep_xy, res.confidence, res.rep_slot,
-            res.num_cliques,
-        )
+    # headline end-to-end).
+    packed = (
+        _pack_result(res)
+        if prefetched_packed is None
+        else prefetched_packed
     )
-    num_cliques = packed[:, 0, 0].astype(np.int64)
-    picked = packed[:, 1:, 0] > 0.5
-    rep_xy = packed[:, 1:, 1:3]
-    confidence = packed[:, 1:, 3]
-    rep_slot = packed[:, 1:, 4].astype(np.int32)
+    picked, rep_xy, confidence, rep_slot, num_cliques = (
+        _unpack_box_outputs(packed)
+    )
     counts = {}
     for i, name in enumerate(batch.names):
         if not name:
@@ -739,11 +757,34 @@ def write_consensus_boxes(
     return counts
 
 
+# Packed-transfer layout (single source of truth — _pack_box_outputs
+# writes it, _packed_probes/_unpack_box_outputs read it):
+#   head row (index 0), channels 0..3: the four overflow probes as
+#     int32 BITS bit-cast into the f32 lanes (exact for the full int32
+#     range — probes are OBSERVED requirements that may exceed any
+#     buffer capacity, so f32's 2^24 integer range is not enough);
+#     probe order matches escalate_capacities.
+#   body rows (1..N), channels: picked, rep_x, rep_y, confidence,
+#     rep_slot — all exact in plain f32.
+_HEAD_ADJ, _HEAD_NC, _HEAD_CELL, _HEAD_PART = 0, 1, 2, 3
+_BODY_PICKED, _BODY_X, _BODY_Y, _BODY_CONF, _BODY_SLOT = range(5)
+
+
 @jax.jit
-def _pack_box_outputs(picked, rep_xy, confidence, rep_slot, num_cliques):
-    """Pack the five BOX-writing outputs into one (M, N+1, 5) f32
-    array so the host pays exactly one device->host transfer."""
+def _pack_box_outputs(
+    picked, rep_xy, confidence, rep_slot,
+    num_cliques, max_adjacency, max_cell_count, max_partial,
+):
+    """Pack the BOX-writing outputs AND the four overflow probes into
+    one (M, N+1, 5) f32 array so the host pays exactly one
+    device->host transfer per chunk (a separate probe fetch and a
+    4-array output fetch each cost a serialized round trip over the
+    tunneled TPU).  Layout above."""
     m = picked.shape[0]
+
+    def bc(x):
+        return jnp.broadcast_to(x, (m,)).astype(jnp.int32)
+
     core = jnp.concatenate(
         [
             picked.astype(jnp.float32)[..., None],
@@ -753,12 +794,50 @@ def _pack_box_outputs(picked, rep_xy, confidence, rep_slot, num_cliques):
         ],
         axis=-1,
     )
-    head = (
-        jnp.zeros((m, 1, 5), jnp.float32)
-        .at[:, 0, 0]
-        .set(jnp.broadcast_to(num_cliques, (m,)).astype(jnp.float32))
+    probe_bits = jax.lax.bitcast_convert_type(
+        jnp.stack(
+            [
+                bc(max_adjacency),
+                bc(num_cliques),
+                bc(max_cell_count),
+                bc(max_partial),
+            ],
+            axis=-1,
+        ),
+        jnp.float32,
     )
+    head = jnp.concatenate(
+        [probe_bits, jnp.zeros((m, 1), jnp.float32)], axis=-1
+    )[:, None, :]
     return jnp.concatenate([head, core], axis=1)
+
+
+def _pack_result(res: "ConsensusResult") -> np.ndarray:
+    """Host-fetch the packed output+probe array for a batched result."""
+    return np.asarray(
+        _pack_box_outputs(
+            res.picked, res.rep_xy, res.confidence, res.rep_slot,
+            res.num_cliques, res.max_adjacency, res.max_cell_count,
+            res.max_partial,
+        )
+    )
+
+
+def _packed_probes(packed: np.ndarray) -> np.ndarray:
+    """(M, 4) int32 per-micrograph probes from the packed head row."""
+    return np.ascontiguousarray(packed[:, 0, :4]).view(np.int32)
+
+
+def _unpack_box_outputs(packed: np.ndarray):
+    """(picked, rep_xy, confidence, rep_slot, num_cliques) host views."""
+    body = packed[:, 1:, :]
+    return (
+        body[:, :, _BODY_PICKED] > 0.5,
+        body[:, :, _BODY_X : _BODY_Y + 1],
+        body[:, :, _BODY_CONF],
+        body[:, :, _BODY_SLOT].astype(np.int32),
+        _packed_probes(packed)[:, _HEAD_NC].astype(np.int64),
+    )
 
 
 def _cc_keep_mask(member_idx, labels, node_mask):
@@ -1162,6 +1241,9 @@ def run_consensus_dir(
             else lambda b: cc_fn(jnp.asarray(b.xy), jnp.asarray(b.mask))
         ),
         fetch=want_tables,
+        # plain BOX output: one packed transfer per chunk carries the
+        # escalation probes AND everything the writer needs
+        packed=not want_tables,
     ):
         parts.append(len(part))
         compute_s += chunk_s
@@ -1181,7 +1263,8 @@ def run_consensus_dir(
             chunk_counts, chunk_nc = write_consensus_boxes(
                 cbatch, res, out_dir, box_size,
                 num_particles=num_particles,
-                with_num_cliques=True,  # same single packed transfer
+                with_num_cliques=True,
+                prefetched_packed=extra,  # zero further transfers
             )
             counts.update(chunk_counts)
             write_s += time.time() - t2
@@ -1214,6 +1297,7 @@ def iter_consensus_chunks(
     use_pallas: bool = False,
     extra_device_outputs=None,
     fetch: bool = False,
+    packed: bool = False,
 ):
     """Run consensus over memory-bounded micrograph chunks.
 
@@ -1235,6 +1319,10 @@ def iter_consensus_chunks(
         fetch: ``device_get`` the result (and extras) per chunk — ONE
             transfer for everything, so per-micrograph consumers
             never pay a round trip per array.
+        packed: run the batch in ``packed_probe`` mode and yield the
+            fetched packed output array in the ``extras`` slot — the
+            BOX-writing path consumes it with zero further transfers.
+            Mutually exclusive with ``fetch``/``extra_device_outputs``.
 
     Yields:
         ``(part, batch, result, extras, seconds)`` per chunk, where
@@ -1243,6 +1331,10 @@ def iter_consensus_chunks(
     """
     from repic_tpu.utils.tracing import annotate
 
+    if packed and (fetch or extra_device_outputs is not None):
+        raise ValueError(
+            "packed is mutually exclusive with fetch/extra_device_outputs"
+        )
     k = len(loaded[0][1])
     nb = bucket_size(max(bs.n for _, sets in loaded for bs in sets))
     chunk = _auto_chunk(len(loaded), k, nb, n_dev)
@@ -1267,16 +1359,22 @@ def iter_consensus_chunks(
                     spatial=spatial,
                     solver=solver,
                     use_pallas=use_pallas,
+                    packed_probe=packed,
                 )
-                extras = (
-                    extra_device_outputs(cbatch)
-                    if extra_device_outputs is not None
-                    else None
-                )
-                if fetch:
-                    res, extras = jax.device_get((res, extras))
+                if packed:
+                    # the escalation check already fetched everything
+                    # the writer needs — no further device transfers
+                    res, extras = res
                 else:
-                    jax.block_until_ready(res.picked)
+                    extras = (
+                        extra_device_outputs(cbatch)
+                        if extra_device_outputs is not None
+                        else None
+                    )
+                    if fetch:
+                        res, extras = jax.device_get((res, extras))
+                    else:
+                        jax.block_until_ready(res.picked)
         except Exception as e:  # noqa: BLE001 — filtered to OOM below
             if _is_oom_error(e) and chunk > n_dev:
                 chunk = max(

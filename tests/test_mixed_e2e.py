@@ -214,6 +214,40 @@ def test_mixed_size_writer_rows(tmp_path, batch, results):
         assert len({r[2] for r in rows}) == 2
 
 
+def test_packed_probe_bitcast_exact():
+    """Probes ride the packed transfer as int32 BITS in f32 lanes:
+    values beyond f32's 2^24 exact-integer range (observed
+    requirements can exceed any capacity) must round-trip exactly."""
+    import jax.numpy as jnp
+
+    from repic_tpu.pipeline.consensus import (
+        _pack_box_outputs,
+        _packed_probes,
+        _unpack_box_outputs,
+    )
+
+    m, n = 2, 3
+    big = 16_777_217  # 2^24 + 1: rounds if stored as a f32 value
+    packed = np.asarray(
+        _pack_box_outputs(
+            jnp.ones((m, n), bool),
+            jnp.zeros((m, n, 2), jnp.float32),
+            jnp.zeros((m, n), jnp.float32),
+            jnp.zeros((m, n), jnp.int32),
+            jnp.asarray([big, 7], jnp.int32),       # num_cliques
+            jnp.asarray([big + 2, 1], jnp.int32),   # max_adjacency
+            jnp.asarray([2, 2], jnp.int32),         # max_cell_count
+            jnp.asarray([2**30, 0], jnp.int32),     # max_partial
+        )
+    )
+    probes = _packed_probes(packed)
+    assert probes[0, 0] == big + 2
+    assert probes[0, 1] == big
+    assert probes[0, 3] == 2**30
+    *_, nc = _unpack_box_outputs(packed)
+    assert nc[0] == big and nc[1] == 7
+
+
 def test_writer_uses_rep_slot_sizes_directly(tmp_path):
     """Deterministic cover of the per-row-size branch: crafted result
     with representatives from both size classes."""
