@@ -187,8 +187,25 @@ def local_maxima_mask(score_map: jnp.ndarray, window: int):
     return (score_map == data_max) & (data_max - data_min > 0)
 
 
+@functools.partial(jax.jit, static_argnames=("window",))
+def _pack_score_and_maxima(smap, window: int):
+    """Score map + its local-maxima mask as ONE stacked f32 array.
+
+    ``pick_micrograph`` fetches this single array instead of fetching
+    the score map, re-uploading it for :func:`local_maxima_mask`, and
+    fetching the mask — three tunnel round trips collapsed to one.
+    """
+    smap = smap.astype(jnp.float32)
+    return jnp.stack(
+        [smap, local_maxima_mask(smap, window).astype(jnp.float32)]
+    )
+
+
 def peak_detection(
-    score_map: np.ndarray, window: int, device_nms: bool | None = None
+    score_map: np.ndarray,
+    window: int,
+    device_nms: bool | None = None,
+    maxima: np.ndarray | None = None,
 ):
     """Local maxima + raster-order greedy suppression.
 
@@ -209,9 +226,12 @@ def peak_detection(
     from scipy import ndimage
 
     score_map = np.asarray(score_map)
-    maxima = np.asarray(
-        local_maxima_mask(jnp.asarray(score_map), window)
-    )
+    if maxima is None:
+        maxima = np.asarray(
+            local_maxima_mask(jnp.asarray(score_map), window)
+        )
+    else:
+        maxima = np.asarray(maxima, bool)
     labeled, num = ndimage.label(maxima)
     if num == 0:
         return np.zeros((0, 3), np.float64)
@@ -310,7 +330,10 @@ def pick_micrograph(
             arch=arch, dtype=dtype,
         )
         eff_step = step
-    peaks = peak_detection(np.asarray(smap), max(window, 1))
+    # one fetch: score map + maxima mask ride a single stacked array
+    w = max(window, 1)
+    packed = np.asarray(_pack_score_and_maxima(smap, w))
+    peaks = peak_detection(packed[0], w, maxima=packed[1] > 0.5)
     if len(peaks) == 0:
         return peaks
     coords = peaks.copy()
