@@ -228,41 +228,98 @@ def run_consensus_giant(
         if not retry:
             break
 
-    # Stripe-local -> global member ids (vectorized gather), flatten
-    # stripes, and solve the ONE global packing problem.
-    member = np.asarray(cs.member_idx)      # (S, cap, K)
-    valid = np.asarray(cs.valid).reshape(-1)
-    l2g_np = np.asarray(l2g)                # (S, K, nb)
-    S, cap_out, _ = member.shape
-    glob = np.empty((S, cap_out, k), np.int32)
-    for p in range(k):
-        glob[..., p] = np.take_along_axis(
-            l2g_np[:, p, :], member[..., p], axis=1
-        )
-    glob = glob.reshape(-1, k)
-    w = np.asarray(cs.w).reshape(-1)
-    vid = jnp.asarray(glob) + (
-        jnp.arange(k, dtype=jnp.int32) * n_max
-    )[None, :]
-    vid = jnp.where(jnp.asarray(valid)[:, None], vid, 0)
-    solve = solve_lp_rounding if solver == "lp" else solve_greedy
-    picked = np.asarray(
-        solve(
-            vid,
-            jnp.asarray(w),
-            jnp.asarray(valid),
-            k * n_max,
+    # Stripe-local -> global member mapping, the ONE global packing
+    # solve, and output packing all stay ON DEVICE; the host fetches a
+    # single array.  (The previous host-side version fetched eight
+    # arrays separately and re-uploaded the solve inputs — ~9
+    # serialized round trips per giant micrograph over the tunnel.)
+    packed = np.asarray(
+        _finalize_giant(
+            cs.member_idx, cs.valid, cs.w, cs.confidence,
+            cs.rep_xy, cs.rep_slot, cs.num_valid,
+            jnp.asarray(l2g),
+            k=k, n_max=int(n_max), solver=solver,
         )
     )
+    num_cliques = int(
+        np.ascontiguousarray(packed[0, :1]).view(np.int32)[0]
+    )
+    body = packed[1:]
+    glob = np.ascontiguousarray(body[:, :k]).view(np.int32)
+    picked = body[:, k + _G_PICKED] > 0.5
+    valid = body[:, k + _G_VALID] > 0.5
     return {
         "member_idx": glob,
-        "w": w,
-        "confidence": np.asarray(cs.confidence).reshape(-1),
-        "rep_xy": np.asarray(cs.rep_xy).reshape(-1, 2),
-        "rep_slot": np.asarray(cs.rep_slot).reshape(-1),
+        "w": body[:, k + _G_W],
+        "confidence": body[:, k + _G_CONF],
+        "rep_xy": body[:, k + _G_X : k + _G_Y + 1],
+        "rep_slot": body[:, k + _G_SLOT].astype(np.int32),
         "valid": valid,
         "picked": picked & valid,
-        "num_cliques": int(np.asarray(cs.num_valid).sum()),
+        "num_cliques": num_cliques,
         "n_stripes": n_stripes,
         "stripe_capacity": xy.shape[2],
     }
+
+
+# _finalize_giant packed-body channel offsets AFTER the K member-id
+# channels (single source of truth for writer and reader; the member
+# ids and the head-row count ride as int32 bits in the f32 lanes):
+_G_PICKED, _G_VALID, _G_W, _G_CONF, _G_X, _G_Y, _G_SLOT = range(7)
+
+
+@partial(jax.jit, static_argnames=("k", "n_max", "solver"))
+def _finalize_giant(
+    member, valid, w, confidence, rep_xy, rep_slot, num_valid,
+    l2g, *, k: int, n_max: int, solver: str,
+):
+    """Global mapping + solve + single-array packing, all on device.
+
+    Returns ``(1 + S*cap, K+7)`` f32: head row carries the total valid
+    clique count as int32 BITS in channel 0 (exact for all int32);
+    body channels: ``glob members (K, int32 bits), picked, valid, w,
+    confidence, rep_x, rep_y, rep_slot``.
+    """
+    glob = jnp.stack(
+        [
+            jnp.take_along_axis(
+                l2g[:, p, :], member[:, :, p], axis=1
+            )
+            for p in range(k)
+        ],
+        axis=-1,
+    ).reshape(-1, k)                              # (S*cap, K) global
+    flat_valid = valid.reshape(-1)
+    flat_w = w.reshape(-1)
+    vid = glob + (jnp.arange(k, dtype=jnp.int32) * n_max)[None, :]
+    vid = jnp.where(flat_valid[:, None], vid, 0)
+    solve = solve_lp_rounding if solver == "lp" else solve_greedy
+    picked = solve(vid, flat_w, flat_valid, k * n_max)
+    # channel order after the K member columns MUST match the _G_*
+    # offsets above
+    channels = [None] * 7
+    channels[_G_PICKED] = picked.astype(jnp.float32)[:, None]
+    channels[_G_VALID] = flat_valid.astype(jnp.float32)[:, None]
+    channels[_G_W] = flat_w.astype(jnp.float32)[:, None]
+    channels[_G_CONF] = (
+        confidence.reshape(-1)[:, None].astype(jnp.float32)
+    )
+    channels[_G_X] = rep_xy.reshape(-1, 2).astype(jnp.float32)[:, :1]
+    channels[_G_Y] = rep_xy.reshape(-1, 2).astype(jnp.float32)[:, 1:]
+    channels[_G_SLOT] = (
+        rep_slot.reshape(-1)[:, None].astype(jnp.float32)
+    )
+    body = jnp.concatenate(
+        [jax.lax.bitcast_convert_type(glob, jnp.float32)] + channels,
+        axis=1,
+    )                                             # (S*cap, K+7)
+    head = (
+        jnp.zeros((1, k + 7), jnp.float32)
+        .at[0, 0]
+        .set(
+            jax.lax.bitcast_convert_type(
+                jnp.sum(num_valid).astype(jnp.int32), jnp.float32
+            )
+        )
+    )
+    return jnp.concatenate([head, body], axis=0)
