@@ -178,3 +178,76 @@ def test_outlier_chunk_does_not_promote_base_config():
     C.run_consensus_batch(batch(False), 180.0, use_mesh=False)
     # dense chunks stopped arriving: the config demotes again
     assert C._LAST_GOOD_CONFIG[key][0] == base_cfg[0]
+
+
+def test_packed_probe_escalation_matches_default():
+    """The packed-probe path (one fused transfer carrying probes AND
+    writer outputs) must survive a forced escalation retry: record a
+    sparse batch's small config, then feed a dense same-shape batch —
+    the packed head-row probes drive the retry, and the final result
+    equals the default (separate-probe-fetch) path exactly."""
+    import repic_tpu.pipeline.consensus as C
+
+    rng = np.random.default_rng(11)
+    n = 96
+
+    def make(dense):
+        if dense:
+            base = rng.uniform(700, 760, size=(n, 2)).astype(np.float32)
+        else:
+            gx, gy = np.meshgrid(np.arange(12), np.arange(8))
+            base = (
+                np.stack([gx, gy], -1).reshape(-1, 2)[:n] * 400.0
+            ).astype(np.float32)
+        xy = np.stack(
+            [base + rng.normal(0, 10, base.shape).astype(np.float32)
+             for _ in range(3)]
+        )[None]
+        conf = rng.uniform(0.1, 1, size=(1, 3, n)).astype(np.float32)
+        mask = np.ones((1, 3, n), bool)
+        return PaddedBatch(
+            xy=xy, conf=conf, mask=mask, names=("m0",),
+            counts=np.full((1, 3), n, np.int32),
+        )
+
+    sparse, dense = make(False), make(True)
+    key = (sparse.xy.shape, (180.0,), 0.3, False)
+    C._LAST_GOOD_CONFIG.pop(key, None)
+    C._RECENT_REQUIREMENTS.pop(key, None)
+    # seed a small config from the sparse batch (packed mode too)
+    _, _ = C.run_consensus_batch(
+        sparse, 180.0, use_mesh=False, packed_probe=True
+    )
+    small = C._LAST_GOOD_CONFIG[key]
+    # dense same-shape batch must escalate within packed mode (the
+    # lower-median record policy keeps the RECORDED config at the
+    # sparse value — the retry is local): the packed head-row probes
+    # prove the dense requirement exceeded the seeded capacity
+    res_p, packed = C.run_consensus_batch(
+        dense, 180.0, use_mesh=False, packed_probe=True
+    )
+    assert C._packed_probes(packed).max(axis=0)[0] > small[0]
+    # the packed encoding must mirror the live result it rode with
+    picked_p, rep_p, _conf_p, _slot_p, nc_p = C._unpack_box_outputs(
+        packed
+    )
+    np.testing.assert_array_equal(
+        picked_p, np.asarray(res_p.picked & res_p.valid)
+    )
+    # ...and agree exactly with the default path on the same data
+    C._LAST_GOOD_CONFIG.pop(key, None)
+    C._RECENT_REQUIREMENTS.pop(key, None)
+    res_d = C.run_consensus_batch(dense, 180.0, use_mesh=False)
+    sel_p = np.where(picked_p[0])[0]
+    sel_d = np.where(np.asarray(res_d.picked[0]))[0]
+
+    def rows_sorted(a):
+        # sort whole (x, y) ROWS so differing point sets cannot
+        # false-pass a column-independent sort
+        return a[np.lexsort((a[:, 1], a[:, 0]))]
+
+    np.testing.assert_array_equal(
+        rows_sorted(rep_p[0][sel_p]),
+        rows_sorted(np.asarray(res_d.rep_xy[0])[sel_d]),
+    )
+    assert int(nc_p[0]) == int(np.asarray(res_d.num_cliques[0]))
