@@ -828,6 +828,72 @@ def _packed_probes(packed: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(packed[:, 0, :4]).view(np.int32)
 
 
+@jax.jit
+def _pack_full_result(res: "ConsensusResult"):
+    """The ENTIRE ConsensusResult as one (M, C+1, K+7) f32 array.
+
+    The tables path (``--multi_out``/``--get_cc`` and the two-phase
+    ``get_cliques`` pickles) consumes every result field on the host;
+    a tree ``device_get`` pays ~10 serialized round trips per chunk
+    over the tunnel.  Channels: K member-id columns as int32 BITS,
+    then rep_x, rep_y, w, confidence, rep_slot (int32 bits), picked,
+    valid.  Head row (clique index 0), channels 0..3: the CANONICAL
+    probe order (_HEAD_ADJ, _HEAD_NC, _HEAD_CELL, _HEAD_PART) as
+    int32 bits — readable by the shared :func:`_packed_probes`.
+    """
+    m, _, k = res.member_idx.shape
+    bits = lambda x: jax.lax.bitcast_convert_type(  # noqa: E731
+        x.astype(jnp.int32), jnp.float32
+    )
+    body = jnp.concatenate(
+        [
+            bits(res.member_idx),
+            res.rep_xy.astype(jnp.float32),
+            res.w.astype(jnp.float32)[..., None],
+            res.confidence.astype(jnp.float32)[..., None],
+            bits(res.rep_slot)[..., None],
+            res.picked.astype(jnp.float32)[..., None],
+            res.valid.astype(jnp.float32)[..., None],
+        ],
+        axis=-1,
+    )                                             # (M, C, K+7)
+    scalars = jnp.stack(
+        [
+            jnp.broadcast_to(res.max_adjacency, (m,)),
+            jnp.broadcast_to(res.num_cliques, (m,)),
+            jnp.broadcast_to(res.max_cell_count, (m,)),
+            jnp.broadcast_to(jnp.asarray(res.max_partial), (m,)),
+        ],
+        axis=-1,
+    )
+    head = jnp.concatenate(
+        [bits(scalars), jnp.zeros((m, k + 3), jnp.float32)], axis=-1
+    )[:, None, :]
+    return jnp.concatenate([head, body], axis=1)
+
+
+def _unpack_full_result(packed: np.ndarray, k: int) -> "ConsensusResult":
+    """Rebuild a host-side ConsensusResult (same dtypes device_get
+    would have produced) from one fetched :func:`_pack_full_result`
+    array."""
+    head = _packed_probes(packed)
+    body = packed[:, 1:, :]
+    ints = np.ascontiguousarray(body[:, :, : k]).view(np.int32)
+    return ConsensusResult(
+        rep_xy=body[:, :, k : k + 2],
+        confidence=body[:, :, k + 3],
+        w=body[:, :, k + 2],
+        member_idx=ints,
+        rep_slot=np.ascontiguousarray(body[:, :, k + 4]).view(np.int32),
+        picked=body[:, :, k + 5] > 0.5,
+        valid=body[:, :, k + 6] > 0.5,
+        num_cliques=head[:, _HEAD_NC],
+        max_adjacency=head[:, _HEAD_ADJ],
+        max_cell_count=head[:, _HEAD_CELL],
+        max_partial=head[:, _HEAD_PART],
+    )
+
+
 def _unpack_box_outputs(packed: np.ndarray):
     """(picked, rep_xy, confidence, rep_slot, num_cliques) host views."""
     body = packed[:, 1:, :]
@@ -1372,7 +1438,15 @@ def iter_consensus_chunks(
                         else None
                     )
                     if fetch:
-                        res, extras = jax.device_get((res, extras))
+                        # one packed transfer for the whole result (a
+                        # tree device_get serializes ~10 round trips);
+                        # extras (CC labels) remain a second fetch
+                        # only when requested
+                        res = _unpack_full_result(
+                            np.asarray(_pack_full_result(res)), k
+                        )
+                        if extras is not None:
+                            extras = jax.device_get(extras)
                     else:
                         jax.block_until_ready(res.picked)
         except Exception as e:  # noqa: BLE001 — filtered to OOM below
