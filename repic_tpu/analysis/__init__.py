@@ -1,0 +1,35 @@
+"""JAX/TPU-aware static analysis for the repic_tpu codebase.
+
+The dominant silent failure modes of a JAX/TPU pipeline are not
+crashes: recompilation storms, per-iteration host<->device syncs, and
+PRNG key reuse keep producing correct-looking output while quietly
+serializing the fleet.  This package is an AST-level linter for those
+hazards — see :mod:`repic_tpu.analysis.rules` for the rule pack and
+docs/static_analysis.md for rationale, suppression syntax, and how to
+add a rule.
+
+Entry points: ``repic-tpu lint`` and ``python -m repic_tpu.analysis``.
+Programmatic use::
+
+    from repic_tpu.analysis import analyze_source, run_paths
+    findings = run_paths(["repic_tpu"])
+"""
+
+from repic_tpu.analysis.engine import (
+    Finding,
+    analyze_source,
+    format_report,
+    iter_python_files,
+    run_paths,
+)
+from repic_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
+
+__all__ = [
+    "ALL_RULES",
+    "RULES_BY_ID",
+    "Finding",
+    "analyze_source",
+    "format_report",
+    "iter_python_files",
+    "run_paths",
+]

@@ -1,0 +1,9 @@
+"""``python -m repic_tpu.analysis`` — standalone linter entry point."""
+
+import argparse
+
+from repic_tpu.analysis import cli
+
+parser = argparse.ArgumentParser(prog="python -m repic_tpu.analysis")
+cli.add_arguments(parser)
+cli.main(parser.parse_args())

@@ -1,0 +1,91 @@
+"""``repic-tpu lint`` — the JAX/TPU static-analysis subcommand.
+
+Follows the repo's subcommand protocol (``name`` /
+``add_arguments(parser)`` / ``main(args)``, see
+:mod:`repic_tpu.main`) and is also runnable standalone via
+``python -m repic_tpu.analysis``.  Imports NO JAX: linting must work
+(fast) in CI containers with no accelerator and no XLA startup cost.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+name = "lint"
+
+
+def add_arguments(parser: argparse.ArgumentParser) -> None:
+    parser.description = (
+        "AST-based JAX/TPU hygiene linter (rules RT001-RT006: jit "
+        "static_argnames validity, traced-value branching, PRNG key "
+        "reuse, hot-loop host syncs, recompilation hazards, "
+        "in_axes/donate arity). Exits non-zero on any finding; "
+        "suppress a line with `# repic: noqa[RTxxx]`."
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=["repic_tpu"],
+        help="files or directories to lint (default: repic_tpu)",
+    )
+    parser.add_argument(
+        "--select",
+        default=None,
+        metavar="RULES",
+        help="comma-separated rule IDs to run (default: all)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=["text", "json"],
+        default="text",
+        help="report format",
+    )
+    parser.add_argument(
+        "--hints",
+        action="store_true",
+        help="append each rule's fix-hint to its findings",
+    )
+    parser.add_argument(
+        "--statistics",
+        action="store_true",
+        help="append a per-rule finding count to the text report",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule pack (ID, severity, title) and exit",
+    )
+
+
+def main(args: argparse.Namespace) -> None:
+    from repic_tpu.analysis.engine import format_report, run_paths
+    from repic_tpu.analysis.rules import ALL_RULES
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.rule_id} [{rule.severity}] {rule.title}")
+        return
+    select = None
+    if args.select:
+        select = {
+            s.strip().upper() for s in args.select.split(",") if s.strip()
+        }
+        unknown = select - {r.rule_id for r in ALL_RULES}
+        if unknown:
+            sys.exit(f"unknown rule id(s): {', '.join(sorted(unknown))}")
+    findings = run_paths(args.paths, select=select)
+    code = format_report(
+        findings,
+        fmt=args.format,
+        show_hints=args.hints,
+        statistics=args.statistics,
+    )
+    if code:
+        sys.exit(code)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(prog=f"repic-tpu {name}")
+    add_arguments(parser)
+    main(parser.parse_args())

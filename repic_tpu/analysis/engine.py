@@ -1,0 +1,571 @@
+"""Rule engine for the JAX/TPU-aware static analyzer.
+
+The engine owns everything rule-agnostic: file discovery, parsing,
+per-module context construction (import-alias resolution, the jitted-
+callable registry), ``# repic: noqa[RTxxx]`` suppression, finding
+collection/ordering, and report formatting.  Rules live in
+:mod:`repic_tpu.analysis.rules`; each is a small class with an ID,
+severity, fix-hint, and a ``check(ctx)`` method returning findings.
+
+Design constraints (mirroring why this exists at all — see
+docs/static_analysis.md): the hazards it hunts are *silent* on TPU —
+recompilation storms, host<->device sync points, tracer concretization
+— so every rule is purely syntactic/dataflow-local and must run with
+zero JAX imports: linting must stay sub-second and safe to run in any
+environment (CI runs it with no accelerator present).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+import sys
+
+# ``# repic: noqa`` (blanket) or ``# repic: noqa[RT001,RT003]``
+_NOQA_RE = re.compile(
+    r"#\s*repic:\s*noqa(?:\[(?P<ids>[A-Z0-9,\s]+)\])?", re.IGNORECASE
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str        # e.g. "RT002"
+    severity: str    # "error" | "warning"
+    message: str
+    hint: str        # how to fix (rule-level, shown with --hints)
+    path: str
+    line: int        # 1-based
+    col: int         # 0-based
+
+    def format(self, show_hint: bool = False) -> str:
+        s = (
+            f"{self.path}:{self.line}:{self.col + 1}: "
+            f"{self.rule} [{self.severity}] {self.message}"
+        )
+        if show_hint and self.hint:
+            s += f"\n    hint: {self.hint}"
+        return s
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class ImportMap:
+    """Local name -> canonical dotted path, from a module's imports.
+
+    ``import jax.numpy as jnp`` maps ``jnp -> jax.numpy``;
+    ``from functools import partial`` maps ``partial ->
+    functools.partial``.  :meth:`resolve` canonicalizes a
+    Name/Attribute chain (``jnp.asarray`` -> ``jax.numpy.asarray``) so
+    rules match semantics, not surface spelling.
+    """
+
+    def __init__(self, tree: ast.Module):
+        self.names: dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.names[a.asname] = a.name
+                    else:
+                        root = a.name.split(".")[0]
+                        self.names[root] = root
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.level:  # relative import — keep package-local
+                    continue
+                for a in node.names:
+                    self.names[a.asname or a.name] = (
+                        f"{node.module}.{a.name}"
+                    )
+
+    def resolve(self, node: ast.expr) -> str | None:
+        """Canonical dotted path of a Name/Attribute chain, or None."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        head = self.names.get(node.id, node.id)
+        parts.append(head)
+        return ".".join(reversed(parts))
+
+
+# numpy may be imported as np/onp/numpy; canonicalization happens via
+# ImportMap, so rules compare against these canonical prefixes only.
+JIT = "jax.jit"
+VMAP = "jax.vmap"
+PARTIAL = "functools.partial"
+PRNG_NEW = {"jax.random.PRNGKey", "jax.random.key"}
+
+
+def positional_params(fn) -> list:
+    """Positional parameter names (posonly + regular) of a def/lambda."""
+    a = fn.args
+    return [p.arg for p in a.posonlyargs] + [p.arg for p in a.args]
+
+
+def _jit_call_info(call: ast.Call, imports: ImportMap):
+    """If ``call`` is ``jax.jit(...)`` or ``functools.partial(jax.jit,
+    ...)``, return its keyword dict; else None."""
+    target = imports.resolve(call.func)
+    if target == JIT:
+        return {k.arg: k.value for k in call.keywords if k.arg}
+    if target == PARTIAL and call.args:
+        if imports.resolve(call.args[0]) == JIT:
+            return {k.arg: k.value for k in call.keywords if k.arg}
+    return None
+
+
+def _const_str_tuple(node: ast.expr) -> list[str] | None:
+    """Literal static_argnames value -> list of names, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ):
+                return None
+            out.append(e.value)
+        return out
+    return None
+
+
+def _const_int_tuple(node: ast.expr) -> list[int] | None:
+    """Literal static_argnums/donate_argnums -> list of ints."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (
+                isinstance(e, ast.Constant)
+                and isinstance(e.value, int)
+                and not isinstance(e.value, bool)
+            ):
+                return None
+            out.append(e.value)
+        return out
+    return None
+
+
+@dataclasses.dataclass
+class JitSite:
+    """One resolved jit application: decorator or direct call."""
+
+    call_kwargs: dict          # jit keywords (AST value nodes)
+    func: object               # FunctionDef | AsyncFunctionDef | Lambda
+    static_names: set          # params bound statically (jit static_
+    #                            argnames/argnums + partial-bound kw)
+    node: ast.AST              # node to report against
+    path: str
+
+
+class ModuleContext:
+    """Everything rules need about one parsed module.
+
+    Name resolution is SCOPE-AWARE: ``f = jax.jit(g)`` /
+    ``batched = jax.vmap(one)`` assignments are recorded per enclosing
+    function, and lookups walk the lexical scope chain outward.  A
+    module-global last-wins map would let an unrelated local variable
+    in another function shadow the name being resolved (this bit the
+    real codebase: an unrelated ``single = chunk >= len(loaded)``
+    shadowed the consensus vmap chain's ``single``).
+    """
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.imports = ImportMap(tree)
+        # name -> first FunctionDef anywhere (rule fallback lookups)
+        self.defs: dict[str, ast.FunctionDef] = {}
+        # id(scope)|None -> {name: value node or FunctionDef}
+        self._scope_names: dict = {None: {}}
+        # id(scope_node) -> enclosing scope node (None = module)
+        self._scope_parent: dict = {}
+        # id(any node) -> innermost enclosing function scope node
+        self._node_scope: dict = {}
+        self._index(tree, None)
+        self.jit_sites = self._collect_jit_sites()
+        # Names statically known to be jitted callables: decorated
+        # defs plus ``name = jax.jit(...)`` assignments.
+        self.jitted_names: set[str] = set()
+        for site in self.jit_sites:
+            if isinstance(
+                site.func, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                self.jitted_names.add(site.func.name)
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and _jit_call_info(node.value, self.imports) is not None
+            ):
+                self.jitted_names.add(node.targets[0].id)
+
+    # -- scope indexing -----------------------------------------------
+
+    def _index(self, node, scope):
+        """One recursive pass filling the scope tables."""
+        skip = set()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # decorators were already indexed in the OUTER scope
+            skip = {id(d) for d in node.decorator_list}
+        for child in ast.iter_child_nodes(node):
+            if id(child) in skip:
+                continue
+            self._node_scope[id(child)] = scope
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                self.defs.setdefault(child.name, child)
+                self._scope_names.setdefault(
+                    id(scope) if scope else None, {}
+                )[child.name] = child
+                self._scope_parent[id(child)] = scope
+                self._scope_names.setdefault(id(child), {})
+                # decorators/defaults evaluate in the OUTER scope
+                for dec in child.decorator_list:
+                    self._index_expr(dec, scope)
+                self._index(child, child)
+            else:
+                if isinstance(child, ast.Assign) and len(
+                    child.targets
+                ) == 1 and isinstance(child.targets[0], ast.Name):
+                    self._scope_names.setdefault(
+                        id(scope) if scope else None, {}
+                    )[child.targets[0].id] = child.value
+                self._index(child, scope)
+
+    def _index_expr(self, node, scope):
+        self._node_scope[id(node)] = scope
+        for child in ast.iter_child_nodes(node):
+            self._index_expr(child, scope)
+
+    def scope_of(self, node):
+        """Innermost enclosing function scope of an indexed node."""
+        return self._node_scope.get(id(node))
+
+    def lookup(self, name: str, scope):
+        """Resolve ``name`` along the lexical scope chain."""
+        while True:
+            key = id(scope) if scope is not None else None
+            bound = self._scope_names.get(key, {})
+            if name in bound:
+                return bound[name]
+            if scope is None:
+                return None
+            scope = self._scope_parent.get(id(scope))
+
+    # -- jit site discovery -------------------------------------------
+
+    def resolve_callable(self, node, scope=None, _depth=0):
+        """Chase ``node`` to a function definition.
+
+        Returns ``(funcdef_or_lambda, extra_static_names)`` or
+        ``(None, set())``.  Chases: a Name bound (in the lexical scope
+        chain) to a def or a simple assignment,
+        ``functools.partial(f, **kw)`` (the bound keyword names become
+        static), and ``jax.vmap(f, ...)`` (transparent for signature
+        purposes).  ``scope=None`` means: derive the scope from the
+        node's own position (falling back to module scope).
+        """
+        if _depth > 6:
+            return None, set()
+        if scope is None:
+            scope = self.scope_of(node)
+        if isinstance(node, ast.Lambda):
+            return node, set()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return node, set()
+        if isinstance(node, ast.Name):
+            value = self.lookup(node.id, scope)
+            if value is None:
+                value = self.defs.get(node.id)
+            if value is None or value is node:
+                return None, set()
+            return self.resolve_callable(
+                value, self.scope_of(value) or scope, _depth + 1
+            )
+        if isinstance(node, ast.Call):
+            target = self.imports.resolve(node.func)
+            if target == PARTIAL and node.args:
+                fn, static = self.resolve_callable(
+                    node.args[0], scope, _depth + 1
+                )
+                if fn is None:
+                    return None, set()
+                bound = {k.arg for k in node.keywords if k.arg}
+                # positionally bound leading params are static too
+                if isinstance(
+                    fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    params = positional_params(fn)
+                    bound |= set(params[: len(node.args) - 1])
+                return fn, static | bound
+            if target == VMAP and node.args:
+                return self.resolve_callable(
+                    node.args[0], scope, _depth + 1
+                )
+        return None, set()
+
+    def _collect_jit_sites(self) -> list[JitSite]:
+        sites: list[JitSite] = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    kwargs = None
+                    if isinstance(dec, ast.Call):
+                        kwargs = _jit_call_info(dec, self.imports)
+                    elif self.imports.resolve(dec) == JIT:
+                        kwargs = {}
+                    if kwargs is None:
+                        continue
+                    sites.append(
+                        JitSite(
+                            call_kwargs=kwargs,
+                            func=node,
+                            static_names=self._static_names(
+                                kwargs, node
+                            ),
+                            node=dec,
+                            path=self.path,
+                        )
+                    )
+            elif isinstance(node, ast.Call):
+                kwargs = _jit_call_info(node, self.imports)
+                if kwargs is None or not node.args:
+                    continue
+                # direct application: jax.jit(f, ...) — only when f
+                # resolves to a def we can see
+                head = node.args[0]
+                if self.imports.resolve(node.func) == PARTIAL:
+                    continue  # partial(jax.jit, ...) is a decorator
+                fn, extra_static = self.resolve_callable(head)
+                if fn is None:
+                    continue
+                sites.append(
+                    JitSite(
+                        call_kwargs=kwargs,
+                        func=fn,
+                        static_names=(
+                            self._static_names(kwargs, fn)
+                            | extra_static
+                        ),
+                        node=node,
+                        path=self.path,
+                    )
+                )
+        return sites
+
+    @staticmethod
+    def _static_names(kwargs: dict, fn) -> set:
+        static: set[str] = set()
+        names = kwargs.get("static_argnames")
+        if names is not None:
+            static |= set(_const_str_tuple(names) or [])
+        nums = kwargs.get("static_argnums")
+        if nums is not None and hasattr(fn, "args"):
+            params = positional_params(fn)
+            for i in _const_int_tuple(nums) or []:
+                if 0 <= i < len(params):
+                    static.add(params[i])
+        return static
+
+
+class Rule:
+    """Base class: one rule = one ID + severity + hint + check()."""
+
+    rule_id = "RT000"
+    severity = "warning"
+    title = ""
+    hint = ""
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        raise NotImplementedError
+
+    def finding(
+        self, ctx: ModuleContext, node: ast.AST, message: str
+    ) -> Finding:
+        return Finding(
+            rule=self.rule_id,
+            severity=self.severity,
+            message=message,
+            hint=self.hint,
+            path=ctx.path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+        )
+
+
+def suppressed_ids(line: str) -> set | None:
+    """IDs suppressed by a ``# repic: noqa`` comment on ``line``.
+
+    Returns None when there is no noqa comment; an empty set means a
+    blanket suppression (every rule).
+    """
+    m = _NOQA_RE.search(line)
+    if not m:
+        return None
+    ids = m.group("ids")
+    if ids is None:
+        return set()
+    return {s.strip().upper() for s in ids.split(",") if s.strip()}
+
+
+def _is_suppressed(finding: Finding, lines: list[str]) -> bool:
+    idx = finding.line - 1
+    if not (0 <= idx < len(lines)):
+        return False
+    ids = suppressed_ids(lines[idx])
+    if ids is None:
+        return False
+    return not ids or finding.rule in ids
+
+
+def analyze_source(
+    source: str,
+    path: str = "<string>",
+    select: set | None = None,
+    rules=None,
+) -> list[Finding]:
+    """Run the rule pack over one module's source text."""
+    from repic_tpu.analysis.rules import ALL_RULES
+
+    rules = ALL_RULES if rules is None else rules
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [
+            Finding(
+                rule="RT000",
+                severity="error",
+                message=f"syntax error: {e.msg}",
+                hint="",
+                path=path,
+                line=e.lineno or 1,
+                col=(e.offset or 1) - 1,
+            )
+        ]
+    ctx = ModuleContext(path, source, tree)
+    findings: list[Finding] = []
+    for rule_cls in rules:
+        if select and rule_cls.rule_id not in select:
+            continue
+        findings.extend(rule_cls().check(ctx))
+    findings = [f for f in findings if not _is_suppressed(f, ctx.lines)]
+    # stable report order; dedupe identical (rule, line, col) repeats
+    # that loop-body double-passes can produce
+    seen = set()
+    out = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        key = (f.rule, f.path, f.line, f.col)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def iter_python_files(paths, missing=None):
+    """Yield .py files under ``paths`` (files or directories).
+
+    A path that exists as neither is appended to ``missing`` (when
+    given) instead of being silently skipped — a vacuous lint pass on
+    a typo'd path must not read as a green gate.
+    """
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        if not os.path.isdir(p):
+            if missing is not None:
+                missing.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(
+                d
+                for d in dirnames
+                if not d.startswith(".") and d != "__pycache__"
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def run_paths(paths, select=None) -> list[Finding]:
+    """Lint every Python file under ``paths``."""
+    findings: list[Finding] = []
+    missing: list[str] = []
+    for path in iter_python_files(paths, missing=missing):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(
+                Finding(
+                    rule="RT000",
+                    severity="error",
+                    message=f"cannot read file: {e}",
+                    hint="",
+                    path=path,
+                    line=1,
+                    col=0,
+                )
+            )
+            continue
+        findings.extend(analyze_source(source, path, select=select))
+    for p in missing:
+        findings.append(
+            Finding(
+                rule="RT000",
+                severity="error",
+                message="path does not exist",
+                hint="",
+                path=p,
+                line=1,
+                col=0,
+            )
+        )
+    return findings
+
+
+def format_report(
+    findings,
+    fmt: str = "text",
+    show_hints: bool = False,
+    statistics: bool = False,
+    stream=None,
+) -> int:
+    """Print the report; return the process exit code (0 = clean)."""
+    stream = stream or sys.stdout
+    if fmt == "json":
+        json.dump([f.to_json() for f in findings], stream, indent=2)
+        stream.write("\n")
+    else:
+        for f in findings:
+            stream.write(f.format(show_hint=show_hints) + "\n")
+        if statistics and findings:
+            counts: dict[str, int] = {}
+            for f in findings:
+                counts[f.rule] = counts.get(f.rule, 0) + 1
+            stream.write("--\n")
+            for rule in sorted(counts):
+                stream.write(f"{rule}: {counts[rule]}\n")
+        if findings:
+            n_err = sum(1 for f in findings if f.severity == "error")
+            stream.write(
+                f"found {len(findings)} issue(s) "
+                f"({n_err} error(s), {len(findings) - n_err} warning(s))\n"
+            )
+    return 1 if findings else 0

@@ -1,0 +1,757 @@
+"""The JAX/TPU hazard rule pack (RT001-RT006).
+
+Each rule targets a failure mode that is *silent* on TPU — the program
+stays correct but quietly serializes the fleet (recompiles, host sync)
+or degrades statistics (PRNG reuse).  Rules are deliberately
+dataflow-LOCAL: they reason about one module at a time with no JAX
+import and no type inference, so a clean verdict is cheap and a
+finding is actionable at the reported line.  Cross-module aliasing is
+out of scope by design; the suppression escape hatch
+(``# repic: noqa[RTxxx]``) documents the residual cases.
+
+Rule summary (full rationale in docs/static_analysis.md):
+
+RT001  static_argnames/static_argnums naming unknown parameters
+RT002  Python control flow / concretization on traced values in jit
+RT003  PRNG key consumed twice without an intervening split
+RT004  host<->device sync on jitted outputs inside a hot loop
+RT005  recompilation hazards (jit-in-loop, literal args to jit fns)
+RT006  in_axes / donate_argnums arity mismatch
+"""
+
+from __future__ import annotations
+
+import ast
+
+from repic_tpu.analysis.engine import (
+    JIT,
+    VMAP,
+    PRNG_NEW,
+    Finding,
+    ModuleContext,
+    Rule,
+    _const_int_tuple,
+    _const_str_tuple,
+    positional_params as _params,
+)
+
+# Attribute accesses that yield Python-static metadata even on traced
+# arrays — reading them does NOT propagate tracedness.
+_ESCAPE_ATTRS = {
+    "shape", "ndim", "dtype", "size", "sharding", "aval", "weak_type",
+    "itemsize", "nbytes",
+}
+# Builtins that concretize a tracer (ConcretizationTypeError at trace
+# time, or worse: silent host fallback pre-trace).
+_CONCRETIZERS = {"int", "float", "bool", "complex"}
+# Builtins whose result is always trace-static.
+_STATIC_BUILTINS = {
+    "len", "isinstance", "type", "id", "repr", "str", "hash", "range",
+    "enumerate", "zip",
+}
+# jax.random.* tails that are producers/derivers, not key consumers.
+_PRNG_NONCONSUMING = {"PRNGKey", "key", "fold_in", "clone", "wrap_key_data",
+                      "key_data", "key_impl"}
+_HOST_FETCHES = {"numpy.asarray", "numpy.array", "jax.device_get"}
+
+
+def _all_params(fn) -> list[str]:
+    a = fn.args
+    names = _params(fn) + [p.arg for p in a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _walk_skip_functions(node):
+    """ast.walk that does not descend into nested function bodies."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+class RT001StaticArgnames(Rule):
+    """``static_argnames`` naming parameters that don't exist.
+
+    jax.jit silently IGNORES unknown static_argnames (it warns at
+    best): the intended-static argument stays traced, so every new
+    value retraces and recompiles — the canonical recompilation storm.
+    """
+
+    rule_id = "RT001"
+    severity = "error"
+    title = "static_argnames must name real parameters"
+    hint = (
+        "rename the entry to match the decorated function's signature "
+        "(or drop it); an ignored static_argname leaves the argument "
+        "traced and recompiles on every distinct value"
+    )
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        findings = []
+        for site in ctx.jit_sites:
+            fn = site.func
+            if not hasattr(fn, "args") or fn.args.kwarg is not None:
+                continue  # **kwargs can absorb any name
+            params = set(_all_params(fn))
+            names_node = site.call_kwargs.get("static_argnames")
+            if names_node is not None:
+                for name in _const_str_tuple(names_node) or []:
+                    if name not in params:
+                        findings.append(
+                            self.finding(
+                                ctx,
+                                names_node,
+                                f"static_argnames entry {name!r} is not "
+                                f"a parameter of "
+                                f"{getattr(fn, 'name', '<lambda>')}()",
+                            )
+                        )
+            nums_node = site.call_kwargs.get("static_argnums")
+            if nums_node is not None:
+                n_pos = len(_params(fn))
+                for i in _const_int_tuple(nums_node) or []:
+                    if not -n_pos <= i < n_pos:
+                        findings.append(
+                            self.finding(
+                                ctx,
+                                nums_node,
+                                f"static_argnums index {i} is out of "
+                                f"range for "
+                                f"{getattr(fn, 'name', '<lambda>')}() "
+                                f"({n_pos} positional parameters)",
+                            )
+                        )
+        return findings
+
+
+class _TaintScan:
+    """Sequential taint propagation over one jitted function body.
+
+    ``tainted`` holds names that (dataflow-locally) derive from traced
+    arguments.  Static metadata reads (``x.shape``/``len(x)``) escape;
+    everything else propagates conservatively.
+    """
+
+    def __init__(self, rule: Rule, ctx: ModuleContext):
+        self.rule = rule
+        self.ctx = ctx
+        self.findings: list[Finding] = []
+
+    # -- expression taint ---------------------------------------------
+
+    def taint(self, node, tainted: set) -> bool:
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _ESCAPE_ATTRS:
+                return False
+            return self.taint(node.value, tainted)
+        if isinstance(node, ast.Call):
+            return self._call_taint(node, tainted)
+        if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+        ):
+            # identity tests are trace-static (a tracer is never None;
+            # `if mask is None:` is the canonical optional-arg idiom)
+            return False
+        if isinstance(node, ast.Lambda):
+            return False  # deferred body; calls are checked at the site
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            inner = set(tainted)
+            for gen in node.generators:
+                if self.taint(gen.iter, inner):
+                    for n in ast.walk(gen.target):
+                        if isinstance(n, ast.Name):
+                            inner.add(n.id)
+            parts = (
+                [node.key, node.value]
+                if isinstance(node, ast.DictComp)
+                else [node.elt]
+            )
+            return any(self.taint(p, inner) for p in parts)
+        return any(
+            self.taint(c, tainted) for c in ast.iter_child_nodes(node)
+        )
+
+    def _call_taint(self, node: ast.Call, tainted: set) -> bool:
+        args_tainted = any(
+            self.taint(a, tainted) for a in node.args
+        ) or any(self.taint(k.value, tainted) for k in node.keywords)
+        if isinstance(node.func, ast.Name):
+            if node.func.id in _CONCRETIZERS:
+                if args_tainted:
+                    self.findings.append(
+                        self.rule.finding(
+                            self.ctx,
+                            node,
+                            f"{node.func.id}() concretizes a traced "
+                            "value inside a jitted function (forces "
+                            "trace-time evaluation or a host sync)",
+                        )
+                    )
+                return False
+            if node.func.id in _STATIC_BUILTINS:
+                return False
+        # method call on a traced object stays traced (x.sum(), ...)
+        return args_tainted or self.taint(node.func, tainted)
+
+    # -- statement walk -----------------------------------------------
+
+    def _bind(self, target, value_tainted: bool, tainted: set):
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                if value_tainted:
+                    tainted.add(n.id)
+                else:
+                    tainted.discard(n.id)
+
+    def scan_body(self, body, tainted: set):
+        for stmt in body:
+            self.scan_stmt(stmt, tainted)
+
+    def scan_stmt(self, stmt, tainted: set):
+        if isinstance(stmt, ast.Assign):
+            t = self.taint(stmt.value, tainted)
+            for target in stmt.targets:
+                self._bind(target, t, tainted)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._bind(
+                stmt.target, self.taint(stmt.value, tainted), tainted
+            )
+        elif isinstance(stmt, ast.AugAssign):
+            t = self.taint(stmt.value, tainted) or self.taint(
+                stmt.target, tainted
+            )
+            self._bind(stmt.target, t, tainted)
+        elif isinstance(stmt, ast.If):
+            if self.taint(stmt.test, tainted):
+                self.findings.append(
+                    self.rule.finding(
+                        self.ctx,
+                        stmt,
+                        "Python `if` on a value derived from traced "
+                        "arguments inside a jitted function (use "
+                        "jnp.where / lax.cond, or mark the argument "
+                        "static)",
+                    )
+                )
+            self.scan_body(stmt.body, tainted)
+            self.scan_body(stmt.orelse, tainted)
+        elif isinstance(stmt, ast.While):
+            if self.taint(stmt.test, tainted):
+                self.findings.append(
+                    self.rule.finding(
+                        self.ctx,
+                        stmt,
+                        "Python `while` on a traced value inside a "
+                        "jitted function (use lax.while_loop)",
+                    )
+                )
+            # two passes catch loop-carried taint; the engine dedupes
+            self.scan_body(stmt.body, tainted)
+            self.scan_body(stmt.body, tainted)
+        elif isinstance(stmt, ast.Assert):
+            if self.taint(stmt.test, tainted):
+                self.findings.append(
+                    self.rule.finding(
+                        self.ctx,
+                        stmt,
+                        "`assert` on a traced value inside a jitted "
+                        "function (concretizes; use "
+                        "checkify/debug.check or assert on shapes)",
+                    )
+                )
+        elif isinstance(stmt, ast.For):
+            t_iter = self.taint(stmt.iter, tainted)
+            self._bind(stmt.target, t_iter, tainted)
+            self.scan_body(stmt.body, tainted)
+            self.scan_body(stmt.body, tainted)
+            self.scan_body(stmt.orelse, tainted)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs are scan/map/grad bodies here: their params
+            # are traced by construction
+            inner = set(tainted) | set(_all_params(stmt))
+            self.scan_body(stmt.body, inner)
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self.taint(item.context_expr, tainted)
+            self.scan_body(stmt.body, tainted)
+        elif isinstance(stmt, ast.Try):
+            self.scan_body(stmt.body, tainted)
+            for h in stmt.handlers:
+                self.scan_body(h.body, tainted)
+            self.scan_body(stmt.orelse, tainted)
+            self.scan_body(stmt.finalbody, tainted)
+        elif isinstance(stmt, (ast.Return, ast.Expr)):
+            self.taint(getattr(stmt, "value", None), tainted)
+
+
+class RT002TracedBranch(Rule):
+    """Python control flow on traced values inside a jitted function.
+
+    An ``if``/``while``/``assert``/``int()``/``float()``/``bool()``
+    on a tracer either raises ConcretizationTypeError or — when the
+    value happens to be concrete at trace time (weak types, shapes
+    captured from NumPy) — silently bakes one branch into the
+    compiled program and retraces per distinct value.
+    """
+
+    rule_id = "RT002"
+    severity = "error"
+    title = "no Python branching on traced values"
+    hint = (
+        "replace with jnp.where / jax.lax.cond / jax.lax.while_loop, "
+        "or declare the driving argument in static_argnames"
+    )
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        findings: list[Finding] = []
+        seen: set[int] = set()
+        for site in ctx.jit_sites:
+            fn = site.func
+            if not isinstance(
+                fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue  # lambdas cannot contain statements
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            tainted = set(_all_params(fn)) - site.static_names
+            scan = _TaintScan(self, ctx)
+            scan.scan_body(fn.body, tainted)
+            findings.extend(scan.findings)
+        return findings
+
+
+class RT003KeyReuse(Rule):
+    """A PRNG key consumed by two samplers without a split.
+
+    JAX keys are pure values: passing the same key to two
+    ``jax.random.*`` consumers yields CORRELATED (identical) streams —
+    no error, just silently broken statistics.
+    """
+
+    rule_id = "RT003"
+    severity = "error"
+    title = "PRNG keys are single-use"
+    hint = (
+        "split before each consumer: `key, sub = jax.random.split(key)`"
+        " and pass `sub`; a key that reaches two samplers produces "
+        "identical draws"
+    )
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        findings: list[Finding] = []
+        self._scan_scope(ctx, ctx.tree.body, findings)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_scope(ctx, node.body, findings)
+        return findings
+
+    # -- helpers ------------------------------------------------------
+
+    def _prng_tail(self, ctx, call: ast.Call) -> str | None:
+        target = ctx.imports.resolve(call.func)
+        if target and target.startswith("jax.random."):
+            return target.rsplit(".", 1)[1]
+        return None
+
+    def _scan_scope(self, ctx, body, findings):
+        state: dict[str, str] = {}  # name -> "fresh" | "used"
+        self._scan_body(ctx, body, state, findings)
+
+    def _scan_body(self, ctx, body, state, findings):
+        for stmt in body:
+            self._scan_stmt(ctx, stmt, state, findings)
+
+    def _consume(self, ctx, call, state, findings):
+        """Mark key args of a consuming jax.random call; flag reuse."""
+        for arg in call.args[:1]:  # the key is the first argument
+            if isinstance(arg, ast.Name) and arg.id in state:
+                if state[arg.id] == "used":
+                    findings.append(
+                        self.finding(
+                            ctx,
+                            call,
+                            f"PRNG key {arg.id!r} is consumed a second "
+                            "time without an intervening "
+                            "jax.random.split",
+                        )
+                    )
+                state[arg.id] = "used"
+
+    def _visit_calls(self, ctx, node, state, findings):
+        """Process jax.random calls inside an expression, in order."""
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            tail = self._prng_tail(ctx, call)
+            if tail is None or tail in _PRNG_NONCONSUMING:
+                continue
+            self._consume(ctx, call, state, findings)
+
+    def _scan_stmt(self, ctx, stmt, state, findings):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # separate scope, scanned at top level
+        if isinstance(stmt, ast.Assign):
+            self._visit_calls(ctx, stmt.value, state, findings)
+            fresh = False
+            if isinstance(stmt.value, ast.Call):
+                target = ctx.imports.resolve(stmt.value.func)
+                tail = self._prng_tail(ctx, stmt.value)
+                fresh = target in PRNG_NEW or tail in (
+                    "split", "fold_in", "clone",
+                )
+            for t in stmt.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        if fresh:
+                            state[n.id] = "fresh"
+                        else:
+                            state.pop(n.id, None)
+        elif isinstance(stmt, ast.If):
+            self._visit_calls(ctx, stmt.test, state, findings)
+            s_body, s_else = dict(state), dict(state)
+            self._scan_body(ctx, stmt.body, s_body, findings)
+            self._scan_body(ctx, stmt.orelse, s_else, findings)
+            state.clear()
+            for name in set(s_body) | set(s_else):
+                a, b = s_body.get(name), s_else.get(name)
+                state[name] = "used" if "used" in (a, b) else "fresh"
+        elif isinstance(stmt, (ast.For, ast.While)):
+            if isinstance(stmt, ast.While):
+                self._visit_calls(ctx, stmt.test, state, findings)
+            else:
+                self._visit_calls(ctx, stmt.iter, state, findings)
+            # two passes: a consumer re-using an outer-scope key on
+            # iteration 2 is the classic silent reuse
+            self._scan_body(ctx, stmt.body, state, findings)
+            self._scan_body(ctx, stmt.body, state, findings)
+            self._scan_body(ctx, stmt.orelse, state, findings)
+        elif isinstance(stmt, (ast.With, ast.Try)):
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.stmt):
+                    self._scan_stmt(ctx, child, state, findings)
+                elif isinstance(child, ast.withitem):
+                    self._visit_calls(
+                        ctx, child.context_expr, state, findings
+                    )
+            for attr in ("body", "orelse", "finalbody"):
+                for child in getattr(stmt, attr, []):
+                    self._scan_stmt(ctx, child, state, findings)
+            for h in getattr(stmt, "handlers", []):
+                self._scan_body(ctx, h.body, state, findings)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._visit_calls(ctx, child, state, findings)
+
+
+class RT004HotLoopSync(Rule):
+    """Unconditional host<->device sync on jitted outputs in a loop.
+
+    ``.item()`` / ``np.asarray`` / ``jax.device_get`` / ``print`` /
+    ``float()`` on a jitted result blocks until the device finishes —
+    inside a loop that sync runs EVERY iteration, destroying the async
+    dispatch pipelining that hides TPU latency (and over a tunneled
+    TPU each one is a full round trip).  Syncs guarded by an ``if``
+    inside the loop (periodic logging) are accepted.
+    """
+
+    rule_id = "RT004"
+    severity = "warning"
+    title = "don't sync on jitted outputs every loop iteration"
+    hint = (
+        "accumulate on device and fetch once after the loop, or guard "
+        "the fetch with a periodic `if` (e.g. every N steps)"
+    )
+
+    _SYNC_BUILTINS = {"print", "float", "int", "bool"}
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.For, ast.While)):
+                self._check_loop(ctx, node, findings)
+        return findings
+
+    def _check_loop(self, ctx, loop, findings):
+        hot: set[str] = set()
+        for n in _walk_skip_functions(loop):
+            if isinstance(n, ast.Assign) and self._is_jitted_call(
+                ctx, n.value
+            ):
+                for t in n.targets:
+                    for name in ast.walk(t):
+                        if isinstance(name, ast.Name):
+                            hot.add(name.id)
+        if not hot and not any(
+            self._is_jitted_call(ctx, n)
+            for n in _walk_skip_functions(loop)
+        ):
+            return
+        # the loop's own test/iter runs every iteration too — a
+        # `while float(loss(x)) > eps:` is the headline hazard
+        head = loop.test if isinstance(loop, ast.While) else loop.iter
+        self._scan_expr(ctx, head, hot, findings)
+        self._scan_unguarded(ctx, loop.body, hot, findings)
+
+    def _is_jitted_call(self, ctx, node) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in ctx.jitted_names
+        )
+
+    def _mentions_hot(self, ctx, node, hot) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and n.id in hot:
+                return True
+            if self._is_jitted_call(ctx, n):
+                return True
+        return False
+
+    def _scan_unguarded(self, ctx, body, hot, findings):
+        """Descend only through blocks that run every iteration.
+
+        ``if`` blocks inside the loop are treated as intentional
+        periodic guards (the standard log-every-N idiom) and skipped;
+        nested loops, ``with`` and ``try`` bodies still run each
+        iteration, so they are descended.
+        """
+        for stmt in body:
+            if isinstance(
+                stmt,
+                (ast.If, ast.FunctionDef, ast.AsyncFunctionDef),
+            ):
+                continue  # guarded or deferred — not per-iteration
+            if isinstance(stmt, (ast.For, ast.While)):
+                expr = stmt.iter if isinstance(stmt, ast.For) else stmt.test
+                self._scan_expr(ctx, expr, hot, findings)
+                self._scan_unguarded(ctx, stmt.body, hot, findings)
+                self._scan_unguarded(ctx, stmt.orelse, hot, findings)
+            elif isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    self._scan_expr(
+                        ctx, item.context_expr, hot, findings
+                    )
+                self._scan_unguarded(ctx, stmt.body, hot, findings)
+            elif isinstance(stmt, ast.Try):
+                for blk in (
+                    stmt.body, stmt.orelse, stmt.finalbody,
+                    *(h.body for h in stmt.handlers),
+                ):
+                    self._scan_unguarded(ctx, blk, hot, findings)
+            else:
+                self._scan_expr(ctx, stmt, hot, findings)
+
+    def _scan_expr(self, ctx, node, hot, findings):
+        for n in _walk_skip_functions(node):
+            if isinstance(n, ast.Call):
+                self._check_call(ctx, n, hot, findings)
+        if isinstance(node, ast.Call):
+            self._check_call(ctx, node, hot, findings)
+
+    def _check_call(self, ctx, call, hot, findings):
+        func = call.func
+        # x.item() on a jitted output
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in ("item", "tolist")
+            and self._mentions_hot(ctx, func.value, hot)
+        ):
+            findings.append(
+                self.finding(
+                    ctx,
+                    call,
+                    f".{func.attr}() on a jitted output inside a loop "
+                    "syncs host and device every iteration",
+                )
+            )
+            return
+        target = ctx.imports.resolve(func)
+        if target in _HOST_FETCHES and call.args:
+            if self._mentions_hot(ctx, call.args[0], hot):
+                findings.append(
+                    self.finding(
+                        ctx,
+                        call,
+                        f"{target}() on a jitted output inside a loop "
+                        "syncs host and device every iteration",
+                    )
+                )
+            return
+        if (
+            isinstance(func, ast.Name)
+            and func.id in self._SYNC_BUILTINS
+            and any(
+                self._mentions_hot(ctx, a, hot)
+                for a in list(call.args)
+                + [k.value for k in call.keywords]
+            )
+        ):
+            findings.append(
+                self.finding(
+                    ctx,
+                    call,
+                    f"{func.id}() touching a jitted output inside a "
+                    "loop syncs host and device every iteration",
+                )
+            )
+
+
+class RT005RecompileHazard(Rule):
+    """Recompilation hazards: jit-in-loop and literal pytree args.
+
+    ``jax.jit`` called inside a loop builds a FRESH wrapper per
+    iteration — each has its own trace cache, so every iteration
+    retraces and recompiles.  A dict/list/set literal in argument
+    position of a jitted call re-traces whenever the literal's
+    structure changes (and defeats donation).
+    """
+
+    rule_id = "RT005"
+    severity = "warning"
+    title = "avoid per-iteration jit wrappers and literal pytree args"
+    hint = (
+        "hoist jax.jit out of the loop (or memoize the maker with "
+        "lru_cache); pass arrays / prebuilt pytrees instead of "
+        "dict/list literals"
+    )
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in _walk_skip_functions(loop):
+                if (
+                    isinstance(node, ast.Call)
+                    and ctx.imports.resolve(node.func) == JIT
+                ):
+                    findings.append(
+                        self.finding(
+                            ctx,
+                            node,
+                            "jax.jit called inside a loop creates a "
+                            "fresh wrapper (and a retrace) every "
+                            "iteration",
+                        )
+                    )
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in ctx.jitted_names
+            ):
+                continue
+            for arg in node.args:
+                if isinstance(arg, (ast.Dict, ast.List, ast.Set)):
+                    findings.append(
+                        self.finding(
+                            ctx,
+                            arg,
+                            f"literal {type(arg).__name__.lower()} "
+                            f"argument to jitted "
+                            f"{node.func.id}() re-traces when its "
+                            "structure changes",
+                        )
+                    )
+        return findings
+
+
+class RT006AxesArity(Rule):
+    """``in_axes``/``donate_argnums`` not matching the signature.
+
+    A tuple ``in_axes`` shorter or longer than the mapped function's
+    positional parameter list raises only at first CALL (deep inside
+    vmap internals); ``donate_argnums`` out of range is silently
+    ignored by jit, so the intended buffer donation never happens.
+    """
+
+    rule_id = "RT006"
+    severity = "error"
+    title = "in_axes/donate_argnums must match the signature"
+    hint = (
+        "give in_axes exactly one entry per positional parameter of "
+        "the mapped function; donate_argnums indices must be valid "
+        "positional indices"
+    )
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.imports.resolve(node.func) == VMAP and node.args:
+                self._check_vmap(ctx, node, findings)
+        for site in ctx.jit_sites:
+            self._check_donate(ctx, site, findings)
+        return findings
+
+    def _check_vmap(self, ctx, node, findings):
+        in_axes = next(
+            (k.value for k in node.keywords if k.arg == "in_axes"),
+            node.args[1] if len(node.args) > 1 else None,
+        )
+        if not isinstance(in_axes, (ast.Tuple, ast.List)):
+            return  # scalar/None broadcast form — always valid
+        fn, bound = ctx.resolve_callable(node.args[0])
+        if fn is None or not hasattr(fn, "args"):
+            return
+        if fn.args.vararg is not None:
+            return  # *args absorbs any arity
+        arity = len([p for p in _params(fn) if p not in bound])
+        if len(in_axes.elts) != arity:
+            name = getattr(fn, "name", "<lambda>")
+            findings.append(
+                self.finding(
+                    ctx,
+                    in_axes,
+                    f"in_axes has {len(in_axes.elts)} entries but "
+                    f"{name}() takes {arity} positional "
+                    f"parameter(s)",
+                )
+            )
+
+    def _check_donate(self, ctx, site, findings):
+        fn = site.func
+        if not hasattr(fn, "args") or fn.args.vararg is not None:
+            return
+        donate = site.call_kwargs.get("donate_argnums")
+        if donate is None:
+            return
+        n_pos = len(_params(fn))
+        for i in _const_int_tuple(donate) or []:
+            if not -n_pos <= i < n_pos:
+                findings.append(
+                    self.finding(
+                        ctx,
+                        donate,
+                        f"donate_argnums index {i} is out of range "
+                        f"for {getattr(fn, 'name', '<lambda>')}() "
+                        f"({n_pos} positional parameters)",
+                    )
+                )
+
+
+ALL_RULES = (
+    RT001StaticArgnames,
+    RT002TracedBranch,
+    RT003KeyReuse,
+    RT004HotLoopSync,
+    RT005RecompileHazard,
+    RT006AxesArity,
+)
+
+RULES_BY_ID = {r.rule_id: r for r in ALL_RULES}

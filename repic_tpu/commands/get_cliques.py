@@ -101,7 +101,9 @@ def main(args):
         largest_component_label,
     )
 
-    assert os.path.exists(args.in_dir), "Error - input directory does not exist"
+    assert os.path.exists(
+        args.in_dir
+    ), "Error - input directory does not exist"
     if os.path.isdir(args.out_dir):
         shutil.rmtree(args.out_dir)
     os.makedirs(args.out_dir, exist_ok=True)
@@ -260,7 +262,12 @@ def main(args):
                     "consensus_confidences",
                     "constraint_matrix",
                 ],
-                [w.astype(np.float32), coords_out, conf.astype(np.float32), a_mat],
+                [
+                    w.astype(np.float32),
+                    coords_out,
+                    conf.astype(np.float32),
+                    a_mat,
+                ],
             ):
                 with open(
                     os.path.join(args.out_dir, f"{mname}_{label}.pickle"), "wb"

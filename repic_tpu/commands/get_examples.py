@@ -210,7 +210,11 @@ def main(args) -> None:
             )
     print(
         f"downloaded {done} files, skipped {skipped} existing"
-        + (f", re-downloaded {redownloaded} pin-mismatched" if redownloaded else "")
+        + (
+            f", re-downloaded {redownloaded} pin-mismatched"
+            if redownloaded
+            else ""
+        )
     )
 
 

@@ -17,7 +17,6 @@ import json
 import os
 import shutil
 import subprocess
-import sys
 
 name = "iter_config"
 
@@ -47,7 +46,8 @@ def add_arguments(parser):
         "exp_particles", type=int, help="number of expected particles"
     )
     parser.add_argument(
-        "cryolo_model", help="path to LOWPASS SPHIRE-crYOLO model, or 'builtin'"
+        "cryolo_model",
+        help="path to LOWPASS SPHIRE-crYOLO model, or 'builtin'",
     )
     parser.add_argument(
         "deep_dir", help="path to DeepPicker scripts, or 'builtin'"

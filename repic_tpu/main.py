@@ -31,6 +31,7 @@ COMMANDS = {
     "score": "repic_tpu.utils.scoring",
     "build_subsets": "repic_tpu.utils.subsets",
     "get_examples": "repic_tpu.commands.get_examples",
+    "lint": "repic_tpu.analysis.cli",
 }
 
 

@@ -26,12 +26,12 @@ Two scoring paths share one set of trained weights:
 from __future__ import annotations
 
 import functools
-import math
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from repic_tpu.models import preprocess as pp
 from repic_tpu.models.cnn import (
     FCN_STRIDE,
     PATCH_SIZE,
@@ -41,7 +41,6 @@ from repic_tpu.models.cnn import (
     compute_dtype,
     fc_params_as_conv,
 )
-from repic_tpu.models import preprocess as pp
 
 STEP_SIZE = 4  # autoPicker.py:159 step_size
 ROW_CHUNK = 8  # scored rows per device launch (batch = ROW_CHUNK * out_w)

@@ -1,6 +1,6 @@
+from repic_tpu.ops.cliques import CliqueSet, enumerate_cliques
 from repic_tpu.ops.iou import pair_iou, pairwise_iou_matrix
-from repic_tpu.ops.cliques import enumerate_cliques, CliqueSet
-from repic_tpu.ops.solver import solve_greedy, solve_exact, solve_exact_py
+from repic_tpu.ops.solver import solve_exact, solve_exact_py, solve_greedy
 
 __all__ = [
     "pair_iou",

@@ -62,7 +62,8 @@ class CliqueSet(NamedTuple):
     rep_slot: jax.Array     # (C,) int32 — picker slot of representative
     rep_xy: jax.Array       # (C, 2) float — representative coordinates
     max_adjacency: jax.Array  # () int32 — neighbor-list overflow probe
-    max_cell_count: jax.Array  # () int32 — bucket overflow probe (0 = dense path)
+    # () int32 — bucket overflow probe (0 = dense path)
+    max_cell_count: jax.Array
     # () int32 — valid cliques BEFORE any compaction (product paths);
     # on the staged path, the survivor count at the accepted capacity
     # (equal to the true count whenever max_partial fits — see

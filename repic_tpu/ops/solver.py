@@ -26,10 +26,9 @@ Two TPU-native replacements live here:
   overlap clusters), so exact search is cheap.
 """
 
-import numpy as np
-
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def solve_greedy(
@@ -274,7 +273,11 @@ def solve_exact_py(
         local_index = {int(n): i for i, n in enumerate(nodes)}
         n = len(nodes)
         local_adj = [
-            [local_index[int(b)] for b in adj[int(nodes[i])] if int(b) in local_index]
+            [
+                local_index[int(b)]
+                for b in adj[int(nodes[i])]
+                if int(b) in local_index
+            ]
             for i in range(n)
         ]
         weights = w[nodes].astype(np.float64)

@@ -1113,7 +1113,7 @@ def run_consensus_dir(
     """
     import shutil
 
-    from repic_tpu.utils.tracing import StageTimer, annotate
+    from repic_tpu.utils.tracing import StageTimer
 
     # Flag validation BEFORE any filesystem mutation: the out-dir
     # delete below is destructive, and a bad flag combination must

@@ -192,7 +192,11 @@ def write_box(
     """Write a consensus BOX file in the reference's output format."""
     xy = np.asarray(xy)
     weights = np.asarray(weights)
-    order = np.argsort(-weights, kind="stable") if sort else np.arange(len(weights))
+    order = (
+        np.argsort(-weights, kind="stable")
+        if sort
+        else np.arange(len(weights))
+    )
     if num_particles is not None:
         order = order[:num_particles]
     # scalar box size (the reference's only mode), or one per row for

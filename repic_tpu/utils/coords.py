@@ -18,7 +18,6 @@ This module is deliberately NOT a jit surface — coordinates enter the
 TPU compute path only after batching/padding (parallel/batching.py).
 """
 
-import os
 import re
 import sys
 from dataclasses import dataclass

@@ -23,8 +23,8 @@ Known deviation: an empty ground-truth set yields recall 0.0 here
 """
 
 import os
-from pathlib import Path
 from functools import partial
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,420 @@
+"""Unit fixtures for the RT001-RT006 rule pack.
+
+One positive and one negative snippet per rule, asserting the rule ID
+and the exact reported line, plus a mechanical suppression check: for
+every positive fixture, appending ``# repic: noqa[RTxxx]`` to the
+flagged line must silence exactly that finding.  These fixtures are
+the rule pack's contract — tightening a rule that breaks one of the
+negatives means the rule now false-positives on an idiom this
+codebase relies on (periodic logging guards, static-argname
+branching, shape reads).
+"""
+
+import textwrap
+
+import pytest
+
+from repic_tpu.analysis import analyze_source
+
+# Each entry: (rule_id, positive_source, expected_line,
+#              negative_source)
+CASES = {
+    "RT001": (
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("sizee",))
+        def f(x, size):
+            return x + size
+        """,
+        4,
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("size",))
+        def f(x, size):
+            return x + size
+        """,
+    ),
+    "RT002": (
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+        """,
+        5,
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("mode",))
+        def f(x, mode):
+            if mode == "fast":
+                return x
+            if x.shape[0] > 4:
+                return x + 1
+            return -x
+        """,
+    ),
+    "RT003": (
+        """
+        import jax
+
+        def draw(shape):
+            key = jax.random.PRNGKey(0)
+            a = jax.random.normal(key, shape)
+            b = jax.random.uniform(key, shape)
+            return a, b
+        """,
+        6,
+        """
+        import jax
+
+        def draw(shape):
+            key = jax.random.PRNGKey(0)
+            key, sub = jax.random.split(key)
+            a = jax.random.normal(sub, shape)
+            key, sub = jax.random.split(key)
+            b = jax.random.uniform(sub, shape)
+            return a, b
+        """,
+    ),
+    "RT004": (
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def run(xs):
+            total = 0.0
+            for x in xs:
+                y = step(x)
+                total += float(y)
+            return total
+        """,
+        11,
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def run(xs):
+            ys = []
+            for i, x in enumerate(xs):
+                y = step(x)
+                ys.append(y)
+                if i % 10 == 0:
+                    print(float(y))
+            return ys
+        """,
+    ),
+    "RT005": (
+        """
+        import jax
+
+        def run(fs, xs):
+            out = []
+            for f, x in zip(fs, xs):
+                jf = jax.jit(f)
+                out.append(jf(x))
+            return out
+        """,
+        6,
+        """
+        import jax
+
+        def run(fs, xs):
+            jfs = [jax.jit(f) for f in fs]
+            return [jf(x) for jf, x in zip(jfs, xs)]
+        """,
+    ),
+    "RT006": (
+        """
+        import jax
+
+        def one(xy, mask, size):
+            return xy * mask * size
+
+        batched = jax.vmap(one, in_axes=(0, 0))
+        """,
+        6,
+        """
+        import jax
+
+        def one(xy, mask, size):
+            return xy * mask * size
+
+        batched = jax.vmap(one, in_axes=(0, 0, None))
+        """,
+    ),
+}
+
+
+def _src(s: str) -> str:
+    return textwrap.dedent(s).strip("\n") + "\n"
+
+
+@pytest.mark.parametrize("rule_id", sorted(CASES))
+def test_positive_fires_at_line(rule_id):
+    source, line, _ = CASES[rule_id]
+    findings = analyze_source(_src(source), f"{rule_id}_pos.py")
+    hits = [f for f in findings if f.rule == rule_id]
+    assert hits, f"{rule_id} did not fire; got {findings}"
+    assert hits[0].line == line, (
+        f"{rule_id} fired at line {hits[0].line}, expected {line}: "
+        f"{hits[0].message}"
+    )
+
+
+@pytest.mark.parametrize("rule_id", sorted(CASES))
+def test_negative_is_clean(rule_id):
+    _, _, source = CASES[rule_id]
+    findings = analyze_source(_src(source), f"{rule_id}_neg.py")
+    hits = [f for f in findings if f.rule == rule_id]
+    assert not hits, [f.format() for f in hits]
+
+
+@pytest.mark.parametrize("rule_id", sorted(CASES))
+def test_noqa_suppresses_the_flagged_line(rule_id):
+    source, line, _ = CASES[rule_id]
+    lines = _src(source).splitlines()
+    lines[line - 1] += f"  # repic: noqa[{rule_id}]"
+    findings = analyze_source(
+        "\n".join(lines) + "\n", f"{rule_id}_noqa.py"
+    )
+    assert not [f for f in findings if f.rule == rule_id], findings
+
+
+@pytest.mark.parametrize("rule_id", sorted(CASES))
+def test_blanket_noqa_suppresses(rule_id):
+    source, line, _ = CASES[rule_id]
+    lines = _src(source).splitlines()
+    lines[line - 1] += "  # repic: noqa"
+    findings = analyze_source(
+        "\n".join(lines) + "\n", f"{rule_id}_noqa_all.py"
+    )
+    assert not [f for f in findings if f.rule == rule_id], findings
+
+
+def test_noqa_for_other_rule_does_not_suppress():
+    source, line, _ = CASES["RT002"]
+    lines = _src(source).splitlines()
+    lines[line - 1] += "  # repic: noqa[RT001]"
+    findings = analyze_source("\n".join(lines) + "\n", "cross.py")
+    assert [f for f in findings if f.rule == "RT002"]
+
+
+def test_select_filters_rules():
+    source, _, _ = CASES["RT002"]
+    findings = analyze_source(
+        _src(source), "sel.py", select={"RT003"}
+    )
+    assert findings == []
+
+
+def test_syntax_error_is_reported_not_raised():
+    findings = analyze_source("def f(:\n", "broken.py")
+    assert len(findings) == 1
+    assert findings[0].rule == "RT000"
+    assert findings[0].severity == "error"
+
+
+def test_static_argnums_out_of_range():
+    src = _src(
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(3,))
+        def f(x, y):
+            return x + y
+        """
+    )
+    findings = analyze_source(src, "argnums.py")
+    assert [f for f in findings if f.rule == "RT001"]
+
+
+def test_rt002_concretizer_fires():
+    src = _src(
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return int(x) + 1
+        """
+    )
+    hits = [
+        f
+        for f in analyze_source(src, "conc.py")
+        if f.rule == "RT002"
+    ]
+    assert hits and hits[0].line == 5
+
+
+def test_rt003_loop_reuse_fires():
+    src = _src(
+        """
+        import jax
+
+        def run(n):
+            key = jax.random.PRNGKey(0)
+            out = []
+            for _ in range(n):
+                out.append(jax.random.normal(key))
+            return out
+        """
+    )
+    hits = [
+        f
+        for f in analyze_source(src, "loopkey.py")
+        if f.rule == "RT003"
+    ]
+    assert hits and hits[0].line == 7
+
+
+def test_rt005_literal_arg_fires():
+    src = _src(
+        """
+        import jax
+
+        @jax.jit
+        def g(tree):
+            return tree
+
+        def run():
+            return g([1, 2, 3])
+        """
+    )
+    hits = [
+        f
+        for f in analyze_source(src, "lit.py")
+        if f.rule == "RT005"
+    ]
+    assert hits and hits[0].line == 8
+
+
+def test_rt006_donate_argnums_out_of_range():
+    src = _src(
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def f(x, y):
+            return x + y
+        """
+    )
+    assert [
+        f
+        for f in analyze_source(src, "donate.py")
+        if f.rule == "RT006"
+    ]
+
+
+def test_partial_vmap_jit_chain_resolves():
+    # the consensus-pipeline shape: partial binds the static kwargs,
+    # vmap maps the positionals, jit wraps the vmap — RT002 must see
+    # through all three AND honor the partial-bound names as static
+    src = _src(
+        """
+        from functools import partial
+
+        import jax
+
+        def one(xy, mask, *, solver="greedy"):
+            if solver == "lp":
+                return xy
+            if xy.sum() > 0:
+                return mask
+            return xy
+
+        single = partial(one, solver="lp")
+        batched = jax.vmap(single, in_axes=(0, 0))
+        fn = jax.jit(batched)
+        """
+    )
+    hits = [
+        f for f in analyze_source(src, "chain.py") if f.rule == "RT002"
+    ]
+    assert len(hits) == 1 and hits[0].line == 8
+
+
+def test_rt002_is_none_identity_is_static():
+    # `if mask is None:` — the canonical optional-argument idiom;
+    # identity tests never concretize a tracer
+    src = _src(
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, mask=None):
+            if mask is None:
+                mask = jnp.ones_like(x)
+            return x * mask
+        """
+    )
+    assert not [
+        f for f in analyze_source(src, "isnone.py") if f.rule == "RT002"
+    ]
+
+
+def test_static_argnums_honors_positional_only_params():
+    src = _src(
+        """
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(0,))
+        def h(n, x, /):
+            if n > 2:
+                return x * 2
+            return x
+        """
+    )
+    findings = analyze_source(src, "posonly.py")
+    assert not [f for f in findings if f.rule in ("RT001", "RT002")], [
+        f.format() for f in findings
+    ]
+
+
+def test_rt004_flags_sync_in_while_test():
+    src = _src(
+        """
+        import jax
+
+        @jax.jit
+        def loss(x):
+            return x * 0.5
+
+        def fit(x):
+            while float(loss(x)) > 0.1:
+                x = x * 0.9
+            return x
+        """
+    )
+    hits = [
+        f
+        for f in analyze_source(src, "whiletest.py")
+        if f.rule == "RT004"
+    ]
+    assert hits and hits[0].line == 8
+
+
+def test_missing_path_is_an_error_not_a_green_gate():
+    from repic_tpu.analysis import run_paths
+
+    findings = run_paths(["/no/such/dir/at/all"])
+    assert findings and findings[0].rule == "RT000"
+    assert findings[0].severity == "error"

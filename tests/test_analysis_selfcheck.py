@@ -1,0 +1,80 @@
+"""The lint gate on the package itself.
+
+``repic-tpu lint repic_tpu/`` exiting 0 is an acceptance criterion of
+the analysis subsystem: the rule pack targets hazards this codebase's
+hot paths were explicitly engineered around (one-fetch transfers,
+guarded epoch logging, split-before-consume keys), so any new finding
+means either a real regression or a rule false-positive — both need a
+human decision (fix, or documented ``# repic: noqa[RTxxx]``), never
+silent rot.  The planted-violation test pins the other half of the
+contract: the gate actually FAILS, with the right rule ID and line,
+when a hazard is introduced.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+from repic_tpu.analysis import run_paths
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_package_is_lint_clean():
+    findings = run_paths([os.path.join(ROOT, "repic_tpu")])
+    assert findings == [], "\n".join(
+        f.format(show_hint=True) for f in findings
+    )
+
+
+def test_planted_rt002_fails_with_rule_and_line(tmp_path):
+    scratch = tmp_path / "scratch_violation.py"
+    scratch.write_text(
+        textwrap.dedent(
+            """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+            """
+        ).strip("\n")
+        + "\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "repic_tpu.analysis", str(scratch)],
+        cwd=ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode != 0, proc.stdout
+    assert "RT002" in proc.stdout
+    # the `if x > 0:` is line 5 of the scratch file
+    assert f"{scratch}:5:" in proc.stdout
+
+
+def test_planted_violation_via_cli_dispatcher(tmp_path):
+    scratch = tmp_path / "scratch_key_reuse.py"
+    scratch.write_text(
+        "import jax\n"
+        "key = jax.random.PRNGKey(0)\n"
+        "a = jax.random.normal(key)\n"
+        "b = jax.random.uniform(key)\n"
+    )
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "repic_tpu.main", "lint",
+            str(scratch),
+        ],
+        cwd=ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode != 0, proc.stdout
+    assert "RT003" in proc.stdout
+    assert f"{scratch}:4:" in proc.stdout

@@ -1,0 +1,75 @@
+"""Smoke tests for the ``repic-tpu lint`` entry points.
+
+Same contract as tests/test_bench_smoke.py: CI and the runbook invoke
+these as subprocesses, so argument-surface drift must fail a cheap
+tier-1 test, not a CI job half an hour in.  The linter additionally
+promises to import NO JAX (it must run in sub-second time in
+environments with no accelerator), which the last test pins.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=120):
+    return subprocess.run(
+        [sys.executable] + args,
+        cwd=ROOT,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+
+
+def test_lint_help_exits_zero():
+    proc = _run(["-m", "repic_tpu.main", "lint", "--help"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "RT001" in proc.stdout  # rule IDs are documented in --help
+
+
+def test_module_entry_help_exits_zero():
+    proc = _run(["-m", "repic_tpu.analysis", "--help"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_list_rules_covers_the_pack():
+    proc = _run(["-m", "repic_tpu.analysis", "--list-rules"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    for rule_id in (
+        "RT001", "RT002", "RT003", "RT004", "RT005", "RT006",
+    ):
+        assert rule_id in proc.stdout, rule_id
+
+
+def test_json_format_on_clean_tree(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    proc = _run(
+        ["-m", "repic_tpu.analysis", str(clean), "--format", "json"]
+    )
+    assert proc.returncode == 0, proc.stdout
+    assert json.loads(proc.stdout) == []
+
+
+def test_unknown_select_is_a_usage_error():
+    proc = _run(["-m", "repic_tpu.analysis", "--select", "RT999"])
+    assert proc.returncode != 0
+    assert "RT999" in proc.stderr
+
+
+def test_linter_imports_no_jax():
+    # JAX startup costs seconds and needs an XLA client; the linter
+    # must stay importable and runnable without it (CI lint step).
+    code = (
+        "import sys\n"
+        "import repic_tpu.analysis\n"
+        "from repic_tpu.analysis import run_paths\n"
+        "run_paths([])\n"
+        "assert 'jax' not in sys.modules, 'linter imported jax'\n"
+    )
+    proc = _run(["-c", code])
+    assert proc.returncode == 0, proc.stderr[-2000:]
