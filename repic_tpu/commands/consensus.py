@@ -19,7 +19,7 @@ def add_arguments(parser):
     parser.add_argument(
         "out_dir",
         help="output directory for BOX files "
-        "(WARNING - deleted if it exists)",
+        "(WARNING - deleted if it exists, unless --resume)",
     )
     parser.add_argument("box_size", type=int, help="box size (pixels)")
     parser.add_argument(
@@ -62,10 +62,43 @@ def add_arguments(parser):
     )
     parser.add_argument(
         "--solver",
-        choices=["greedy", "lp"],
+        choices=["greedy", "lp", "exact"],
         default="greedy",
-        help="packing backend: parallel greedy dominance, or LP "
-        "relaxation + rounding (never worse than greedy)",
+        help="packing backend: parallel greedy dominance, LP "
+        "relaxation + rounding (never worse than greedy), or the "
+        "exact host-side branch-and-bound (degrades exact -> lp -> "
+        "greedy under --solver_budget, recorded in the journal)",
+    )
+    parser.add_argument(
+        "--solver_budget",
+        type=float,
+        metavar="SECONDS",
+        help="wall-clock budget per exact solve; on exhaustion the "
+        "solver ladder degrades to LP-rounding then greedy and the "
+        "journal records the degradation (requires --solver exact)",
+    )
+    parser.add_argument(
+        "--resume",
+        action="store_true",
+        help="continue an interrupted run: keep out_dir, skip "
+        "micrographs already completed per its _journal.jsonl, and "
+        "re-process only quarantined/missing entries (the run "
+        "configuration must match _manifest.json)",
+    )
+    parser.add_argument(
+        "--strict",
+        action="store_true",
+        help="fail fast on the first bad input or unrecoverable "
+        "error instead of the default lenient mode (retry ladder + "
+        "quarantine of failing micrographs)",
+    )
+    parser.add_argument(
+        "--retries",
+        type=int,
+        default=None,
+        metavar="N",
+        help="transient-failure retries per rung of the runtime "
+        "ladder (default 2, bounded exponential backoff)",
     )
     parser.add_argument(
         "--pallas",
@@ -102,9 +135,21 @@ def add_arguments(parser):
 
 def main(args):
     from repic_tpu.pipeline.consensus import run_consensus_dir
+    from repic_tpu.runtime.ladder import RetryPolicy
     from repic_tpu.utils.tracing import trace_session
 
+    if args.solver_budget is not None and args.solver != "exact":
+        raise SystemExit(
+            "repic-tpu consensus: error: --solver_budget requires "
+            "--solver exact (the device greedy/lp packers take no "
+            "budget)"
+        )
     spatial = {"auto": None, "on": True, "off": False}[args.spatial]
+    policy = (
+        RetryPolicy(max_retries=args.retries)
+        if args.retries is not None
+        else None
+    )
     with trace_session(args.profile):
         stats = run_consensus_dir(
             args.in_dir,
@@ -120,6 +165,10 @@ def main(args):
             multi_out=args.multi_out,
             get_cc=args.get_cc,
             stripes=args.stripes,
+            resume=args.resume,
+            strict=args.strict,
+            retry_policy=policy,
+            solver_budget_s=args.solver_budget,
         )
     print(json.dumps(stats, default=str, indent=2))
 

@@ -168,7 +168,9 @@ def main(args):
                     entry[i] = node
                     rows.append(entry)
                     weights.append(0.0)
-            with open(out_file, "wt") as o:
+            from repic_tpu.runtime.atomic import atomic_write
+
+            with atomic_write(out_file) as o:
                 o.write("\t".join(labels) + "\n")
                 o.write(
                     "\n".join(

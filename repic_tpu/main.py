@@ -79,6 +79,13 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+    # Operator-facing chaos hook: REPIC_TPU_FAULTS plants
+    # deterministic failures at named runtime sites so the retry/
+    # quarantine/resume machinery can be rehearsed on real runs
+    # (repic_tpu/runtime/faults.py; stdlib-only, no JAX startup).
+    from repic_tpu.runtime import faults
+
+    faults.install_from_env()
     args.func(args)
 
 

@@ -31,6 +31,16 @@ import jax.numpy as jnp
 import numpy as np
 
 
+class SolverBudgetExceeded(RuntimeError):
+    """An exact solve ran out of its wall-clock or node budget.
+
+    Raised (instead of silently falling back) so the runtime's solver
+    ladder (:func:`repic_tpu.runtime.ladder.solve_host_ladder`) can
+    degrade exact -> LP-rounding -> greedy and RECORD the degradation
+    in the run journal.
+    """
+
+
 def solve_greedy(
     member_vertex: jax.Array,
     w: jax.Array,
@@ -208,6 +218,8 @@ def solve_exact_py(
     w: np.ndarray,
     *,
     node_limit: int = 2_000_000,
+    deadline: float | None = None,
+    raise_on_limit: bool = False,
 ) -> np.ndarray:
     """Exact maximum-weight set packing (host-side oracle).
 
@@ -226,10 +238,19 @@ def solve_exact_py(
         node_limit: safety cap on search nodes per component (falls
             back to greedy within the component if exceeded; practical
             components are tiny so this should never trigger).
+        deadline: optional ``time.monotonic()`` cutoff — the search
+            checks it every 64 nodes and raises
+            :class:`SolverBudgetExceeded` when passed (the runtime's
+            solver ladder then degrades to LP-rounding/greedy).
+        raise_on_limit: raise :class:`SolverBudgetExceeded` on a
+            node_limit hit instead of the silent per-component greedy
+            fallback.
 
     Returns:
         ``(C,)`` bool — optimal selection.
     """
+    import time as _time
+
     C = len(w)
     picked = np.zeros(C, dtype=bool)
     if C == 0:
@@ -267,6 +288,11 @@ def solve_exact_py(
         n_comp += 1
 
     for cid in range(n_comp):
+        if deadline is not None and _time.monotonic() > deadline:
+            raise SolverBudgetExceeded(
+                "exact solve exceeded its wall-clock budget "
+                f"({cid}/{n_comp} components searched)"
+            )
         nodes = np.where(comp == cid)[0]
         # Sort heaviest-first for strong bounds; stable index tiebreak.
         nodes = nodes[np.lexsort((nodes, -w[nodes]))]
@@ -293,8 +319,22 @@ def solve_exact_py(
             pos, chosen, blocked, val = stack2.pop()
             nodes_visited += 1
             if nodes_visited > node_limit:
+                if raise_on_limit:
+                    raise SolverBudgetExceeded(
+                        f"exact solve exceeded its node budget "
+                        f"({node_limit} nodes)"
+                    )
                 aborted = True
                 break
+            if (
+                deadline is not None
+                and nodes_visited % 64 == 0
+                and _time.monotonic() > deadline
+            ):
+                raise SolverBudgetExceeded(
+                    "exact solve exceeded its wall-clock budget "
+                    f"(component {cid}, {nodes_visited} nodes)"
+                )
             # Advance past blocked cliques.
             while pos < n and pos in blocked:
                 pos += 1
@@ -333,6 +373,7 @@ def solve_exact(
     w: np.ndarray,
     *,
     node_limit: int = 2_000_000,
+    budget_s: float | None = None,
 ) -> np.ndarray:
     """Exact max-weight set packing, preferring the native C++ core.
 
@@ -340,7 +381,23 @@ def solve_exact(
     framework's compiled replacement for the Gurobi core at reference
     run_ilp.py:50-63) and falls back to :func:`solve_exact_py` when no
     C++ toolchain is available.
+
+    With ``budget_s`` set, runs the interruptible Python oracle with a
+    wall-clock deadline (the native core cannot be preempted
+    mid-search) and raises :class:`SolverBudgetExceeded` when either
+    the deadline or ``node_limit`` is hit — the contract the runtime's
+    degradation ladder builds on.
     """
+    if budget_s is not None:
+        import time as _time
+
+        return solve_exact_py(
+            np.asarray(member_vertex),
+            np.asarray(w),
+            node_limit=node_limit,
+            deadline=_time.monotonic() + budget_s,
+            raise_on_limit=True,
+        )
     from repic_tpu import native
 
     out = native.solve_exact_native(

@@ -55,9 +55,20 @@ def initialize(
 
         if getattr(_jax_distributed.global_state, "client", None) is not None:
             return jax.process_count() > 1  # safe: runtime already up
-    except (ImportError, AttributeError):
-        pass  # private-module layout changed; fall through
-        # (exercised by test_initialize_survives_private_module_removal)
+    except (ImportError, AttributeError) as e:
+        # private-module layout changed; fall through to an explicit
+        # initialize — but say so: silent fallbacks here have hidden
+        # multi-host misconfiguration before.  (Exercised by
+        # test_initialize_survives_private_module_removal.)
+        import warnings
+
+        warnings.warn(
+            "repic_tpu.parallel.distributed: fallback=explicit-init "
+            "reason=jax-private-distributed-state-unavailable "
+            f"({type(e).__name__}: {e})",
+            RuntimeWarning,
+            stacklevel=2,
+        )
     env_np = os.environ.get("JAX_NUM_PROCESSES")
     if num_processes is None and env_np:
         num_processes = int(env_np)
@@ -74,12 +85,22 @@ def initialize(
             process_id=process_id,
             local_device_ids=local_device_ids,
         )
-    except RuntimeError:
+    except RuntimeError as e:
         # Either the launcher already initialized the runtime (fine:
         # idempotent success) or backends were initialized before us
         # (unrecoverable: re-raise).  process_count() is safe to call
         # now — the failed initialize means backends are already up.
         if jax.process_count() > 1:
+            import warnings
+
+            warnings.warn(
+                "repic_tpu.parallel.distributed: "
+                "fallback=reuse-launcher-runtime "
+                f"processes={jax.process_count()} "
+                f"reason=initialize-raised ({e})",
+                RuntimeWarning,
+                stacklevel=2,
+            )
             return True
         raise
     return True

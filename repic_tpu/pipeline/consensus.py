@@ -38,6 +38,17 @@ from repic_tpu.parallel.mesh import (
     consensus_mesh,
     shard_over_micrographs,
 )
+from repic_tpu.runtime import faults
+from repic_tpu.runtime.atomic import atomic_write
+from repic_tpu.runtime.journal import RunJournal, error_info
+from repic_tpu.runtime.ladder import (
+    DEFAULT_POLICY,
+    ChunkOutcomes,
+    RetryPolicy,
+    classify_error,
+    is_oom_error,
+    solve_host_ladder,
+)
 from repic_tpu.utils import box_io
 
 
@@ -1026,16 +1037,63 @@ def write_consensus_tables(
                 cells = ["N/A\tN/A"] * k
                 cells[p] = f"{x}\t{y}"
                 rows.append("\t".join(cells) + "\t0.0")
-        with open(os.path.join(out_dir, name + ".tsv"), "wt") as o:
+        with atomic_write(os.path.join(out_dir, name + ".tsv")) as o:
             o.write("\t".join(pickers) + "\n")
             o.write("\n".join(rows))
         counts[name] = len(chosen)
     return counts
 
 
-def _is_oom_error(e: Exception) -> bool:
-    s = str(e).lower()
-    return "out of memory" in s or "resource_exhausted" in s
+def _host_solve_chunk(
+    part, res, capacity, *, budget_s, outcomes, strict=False
+):
+    """Re-solve one fetched chunk's packings on the host solver ladder.
+
+    ``res`` must be a host-side :class:`ConsensusResult` (the
+    ``fetch=True`` chunk path).  Each micrograph's valid cliques are
+    handed to :func:`repic_tpu.runtime.ladder.solve_host_ladder`
+    (exact under ``budget_s`` -> LP-rounding -> greedy); the rung
+    that actually ran is recorded in ``outcomes.solver`` and any
+    degradation marks the micrograph ``degraded`` for the journal.
+    Returns ``res`` with ``picked`` replaced by the ladder's picks.
+
+    Lenient safety net: an UNEXPECTED solver failure (not budget
+    exhaustion — the ladder absorbs that) keeps the device greedy
+    packing that ``res.picked`` already holds, recorded as a
+    ``greedy``-rung degradation, so one pathological micrograph
+    cannot kill a directory run mid-write.  ``strict`` re-raises.
+    """
+    picked_all = np.array(np.asarray(res.picked), dtype=bool)
+    K = res.member_idx.shape[-1]
+    offsets = np.arange(K, dtype=np.int64) * int(capacity)
+    for i, (name, _sets) in enumerate(part):
+        valid = np.asarray(res.valid[i]).astype(bool)
+        member = np.asarray(res.member_idx[i])[valid].astype(np.int64)
+        wv = np.asarray(res.w[i])[valid]
+        vid = member + offsets[None, :] if member.size else member
+        try:
+            picked_v, used = solve_host_ladder(
+                vid, wv, K * int(capacity),
+                solver="exact", budget_s=budget_s,
+            )
+        except Exception:  # noqa: BLE001 — lenient terminal rung
+            if strict:
+                raise
+            outcomes.solver[name] = "greedy"  # device pack kept
+            outcomes.mark([name], "degraded")
+            continue
+        row = np.zeros(picked_all.shape[1], bool)
+        row[np.where(valid)[0]] = picked_v
+        picked_all[i] = row
+        outcomes.solver[name] = used
+        if used != "exact":
+            outcomes.mark([name], "degraded")
+    return res._replace(picked=picked_all)
+
+
+# OOM classification now lives in the runtime ladder (one policy for
+# every consensus path); this alias keeps the historical name.
+_is_oom_error = is_oom_error
 
 
 def _auto_chunk(n_loaded: int, k: int, nb: int, n_dev: int) -> int:
@@ -1085,6 +1143,10 @@ def run_consensus_dir(
     multi_out: bool = False,
     get_cc: bool = False,
     stripes: int | None = None,
+    resume: bool = False,
+    strict: bool = False,
+    retry_policy: "RetryPolicy | None" = None,
+    solver_budget_s: float | None = None,
 ) -> dict:
     """End-to-end: read picker BOX dirs, consensus, write BOX files.
 
@@ -1108,8 +1170,20 @@ def run_consensus_dir(
     initial chunk size comes from a memory-budget estimate
     (``REPIC_CONSENSUS_CHUNK_BYTES``, default 4 GB, or explicit
     ``REPIC_CONSENSUS_CHUNK``); a chunk that still exhausts device
-    memory is retried at half size — the memory analog of the
-    capacity-escalation ladder in :func:`run_consensus_batch`.
+    memory is retried at half size — one rung of the runtime ladder.
+
+    Fault-tolerant runtime (docs/robustness.md): every micrograph's
+    outcome is journaled to ``_journal.jsonl`` in ``out_dir``.  By
+    default the run is lenient — a malformed BOX file or a micrograph
+    that still fails after the retry/degradation ladder is
+    quarantined (recorded with a structured error, skipped) instead
+    of killing the run; ``strict=True`` restores fail-fast.  With
+    ``resume=True`` an interrupted run of the SAME configuration
+    (pinned by ``_manifest.json``) re-processes only quarantined and
+    missing micrographs.  ``solver="exact"`` solves the packing
+    host-side with the in-framework branch-and-bound; under
+    ``solver_budget_s`` it degrades exact -> LP-rounding -> greedy
+    per micrograph, recording the degradation in the journal.
     """
     import shutil
 
@@ -1121,11 +1195,22 @@ def run_consensus_dir(
     # ("auto" resolves after loading — it never stripes when the
     # requested output needs the batched path, so it conflicts with
     # nothing.)
+    host_solver = solver == "exact"
+    if solver_budget_s is not None and not host_solver:
+        raise ValueError(
+            "solver_budget_s applies to solver='exact' only (the "
+            "device greedy/lp packers take no budget)"
+        )
     if stripes is not None and stripes != "auto":
         if multi_out or get_cc:
             raise ValueError(
                 "--stripes composes with the plain BOX output only "
                 "(use the batched path for --multi_out/--get_cc)"
+            )
+        if host_solver:
+            raise ValueError(
+                "--solver exact composes with the batched path only "
+                "(not --stripes)"
             )
         if stripes < 1:
             raise ValueError(f"--stripes must be >= 1, got {stripes}")
@@ -1138,6 +1223,7 @@ def run_consensus_dir(
                 "dense XLA kernels",
                 stacklevel=2,
             )
+    policy = retry_policy or DEFAULT_POLICY
 
     timer = StageTimer()
     t0 = time.time()
@@ -1147,10 +1233,44 @@ def run_consensus_dir(
     names = box_io.micrograph_names(os.path.join(in_dir, pickers[0]))
     # Same destructive out-dir semantics as get_cliques (reference
     # warns and deletes, get_cliques.py:77): stale outputs from a
-    # previous dataset must not survive a re-run.
-    if os.path.isdir(out_dir):
+    # previous dataset must not survive a re-run.  ``resume`` keeps
+    # the directory and lets the journal decide what still needs
+    # processing (a manifest mismatch below restarts from scratch).
+    if os.path.isdir(out_dir) and not resume:
         shutil.rmtree(out_dir)
     os.makedirs(out_dir, exist_ok=True)
+    # The manifest pins everything that changes output CONTENT (plus
+    # the input name set); perf-only knobs (mesh, chunking, spatial,
+    # pallas) stay out so a resumed run may use different hardware.
+    run_config = {
+        "in_dir": os.path.abspath(in_dir),
+        "box_size": np.asarray(box_size).tolist(),
+        "threshold": threshold,
+        "num_particles": num_particles,
+        "solver": solver,
+        "multi_out": multi_out,
+        "get_cc": get_cc,
+        "pickers": pickers,
+        "names": names,
+    }
+    journal = RunJournal.open(out_dir, run_config, resume=resume)
+    if resume and not journal.resumed:
+        # --resume found a DIFFERENT run (or none) in out_dir: the
+        # restart must be from scratch for real — stale outputs of
+        # the other run must not survive next to this one's.
+        journal.close()
+        shutil.rmtree(out_dir)
+        os.makedirs(out_dir, exist_ok=True)
+        journal = RunJournal.open(out_dir, run_config)
+    out_ext = ".tsv" if multi_out else ".box"
+    already_done = set()
+    if journal.resumed:
+        latest = journal.latest()  # one copy, not one per done name
+        for nm in journal.done_names():
+            out_name = latest[nm].get("out", nm + out_ext)
+            if os.path.exists(os.path.join(out_dir, out_name)):
+                already_done.add(nm)
+    todo_names = [n for n in names if n not in already_done]
 
     # Parallel host-side parse: at the 1024-micrograph scale
     # (BASELINE configs[4]) the sequential loop is the bottleneck,
@@ -1158,27 +1278,37 @@ def run_consensus_dir(
     # threads scale; order stays deterministic via executor.map.
     from concurrent.futures import ThreadPoolExecutor
 
+    def _load_one(nm):
+        """Load one micrograph; in lenient mode a parse/read failure
+        becomes a quarantine record instead of killing the run."""
+        try:
+            return box_io.load_micrograph_set(in_dir, pickers, nm)
+        except (box_io.BoxParseError, OSError) as e:
+            if strict:
+                raise
+            return e
+
     workers = min(32, max(4, os.cpu_count() or 4))
-    if len(names) > 1:
+    if len(todo_names) > 1:
         with ThreadPoolExecutor(max_workers=workers) as ex:
-            all_sets = list(
-                ex.map(
-                    lambda nm: box_io.load_micrograph_set(
-                        in_dir, pickers, nm
-                    ),
-                    names,
-                )
-            )
+            all_sets = list(ex.map(_load_one, todo_names))
     else:
-        all_sets = [
-            box_io.load_micrograph_set(in_dir, pickers, nm)
-            for nm in names
-        ]
-    loaded, skipped = [], []
-    for name, sets in zip(names, all_sets):
-        if sets is None:
+        all_sets = [_load_one(nm) for nm in todo_names]
+    loaded, skipped, quarantined = [], [], {}
+    for name, sets in zip(todo_names, all_sets):
+        if isinstance(sets, BaseException):
+            info = error_info(
+                sets, path=getattr(sets, "path", None),
+                kind=classify_error(sets),
+            )
+            quarantined[name] = info
+            journal.record(
+                name, "quarantined", error=info, stage="load"
+            )
+        elif sets is None:
             skipped.append(name)
             box_io.write_empty_box(os.path.join(out_dir, name + ".box"))
+            journal.record(name, "skipped", out=name + ".box")
         else:
             loaded.append((name, sets))
 
@@ -1186,11 +1316,15 @@ def run_consensus_dir(
         "pickers": pickers,
         "micrographs": len(names),
         "skipped": skipped,
+        "quarantined": quarantined,
+        "resumed": len(already_done),
         "load_s": time.time() - t0,
         "num_cliques": 0,
         "particle_counts": {},
     }
     if not loaded:
+        stats["journal"] = journal.summary()
+        journal.close()
         return stats
 
     timer.stages.append(("load", time.time() - t0))
@@ -1205,7 +1339,7 @@ def run_consensus_dir(
             (bs.n for _, sets in loaded for bs in sets), default=0
         )
         if (
-            not (multi_out or get_cc)
+            not (multi_out or get_cc or host_solver)
             and len(loaded) < n_dev
             and max_n > SPATIAL_THRESHOLD
         ):
@@ -1255,6 +1389,12 @@ def run_consensus_dir(
             )
             write_s += time.time() - t2
             num_cliques += giant["num_cliques"]
+            journal.record(
+                name, "ok",
+                wall_s=round(time.time() - t1, 6),
+                solver=solver, out=name + ".box",
+                particles=counts[name],
+            )
         timer.stages.append(("compute", compute_s))
         timer.stages.append(("write", write_s))
         timer.write_tsv(out_dir, "consensus_runtime.tsv")
@@ -1266,6 +1406,8 @@ def run_consensus_dir(
             num_cliques=num_cliques,
             stripes=actual_stripes,
         )
+        stats["journal"] = journal.summary()
+        journal.close()
         return stats
 
     want_tables = multi_out or get_cc
@@ -1291,6 +1433,12 @@ def run_consensus_dir(
     counts: dict = {}
     num_cliques = 0
     parts = []
+    outcomes = ChunkOutcomes()
+    # The exact solver runs host-side on the fetched result, so it
+    # shares the tables data path; the device program keeps the cheap
+    # greedy pack (its picks are recomputed on the host ladder).
+    want_fetch = want_tables or host_solver
+    device_solver = "greedy" if host_solver else solver
     for part, cbatch, res, extra, chunk_s in iter_consensus_chunks(
         loaded,
         box_size,
@@ -1299,22 +1447,35 @@ def run_consensus_dir(
         max_neighbors=max_neighbors,
         use_mesh=use_mesh,
         spatial=spatial,
-        solver=solver,
+        solver=device_solver,
         use_pallas=use_pallas,
         extra_device_outputs=(
             None
             if cc_fn is None
             else lambda b: cc_fn(jnp.asarray(b.xy), jnp.asarray(b.mask))
         ),
-        fetch=want_tables,
+        fetch=want_fetch,
         # plain BOX output: one packed transfer per chunk carries the
         # escalation probes AND everything the writer needs
-        packed=not want_tables,
+        packed=not want_fetch,
+        strict=strict,
+        policy=policy,
+        outcomes=outcomes,
+        journal=journal,
     ):
         parts.append(len(part))
         compute_s += chunk_s
+        if host_solver:
+            t_solve = time.time()
+            res = _host_solve_chunk(
+                part, res, cbatch.capacity,
+                budget_s=solver_budget_s,
+                outcomes=outcomes,
+                strict=strict,
+            )
+            compute_s += time.time() - t_solve
         t2 = time.time()
-        if want_tables:
+        if want_fetch:
             counts.update(
                 write_consensus_tables(
                     part, res, extra, out_dir, box_size, pickers,
@@ -1335,6 +1496,18 @@ def run_consensus_dir(
             counts.update(chunk_counts)
             write_s += time.time() - t2
             num_cliques += int(chunk_nc.sum())
+        for nm, _sets in part:
+            journal.record(
+                nm,
+                outcomes.status.get(nm, "ok"),
+                wall_s=round(chunk_s / max(len(part), 1), 6),
+                solver=outcomes.solver.get(nm, solver),
+                particles=counts.get(nm),
+                out=nm + out_ext,
+            )
+    # ladder-exhausted micrographs quarantined during chunking (the
+    # iterator already journaled them as they happened)
+    quarantined.update(outcomes.quarantined)
     timer.stages.append(("compute", compute_s))
     timer.stages.append(("write", write_s))
     timer.write_tsv(out_dir, "consensus_runtime.tsv")
@@ -1345,6 +1518,8 @@ def run_consensus_dir(
         particle_counts=counts,
         num_cliques=num_cliques,
     )
+    stats["journal"] = journal.summary()
+    journal.close()
     if len(parts) > 1:
         stats["chunk"] = max(parts)
     return stats
@@ -1364,6 +1539,10 @@ def iter_consensus_chunks(
     extra_device_outputs=None,
     fetch: bool = False,
     packed: bool = False,
+    strict: bool = True,
+    policy: "RetryPolicy | None" = None,
+    outcomes: "ChunkOutcomes | None" = None,
+    journal: "RunJournal | None" = None,
 ):
     """Run consensus over memory-bounded micrograph chunks.
 
@@ -1372,11 +1551,18 @@ def iter_consensus_chunks(
     whole workload, padding sticks to the mesh axis (the historical
     single-batch shapes, so recorded capacity configs and compiled
     programs stay valid); otherwise every chunk pads to the same
-    fixed shape -> one compile, many executions.  A chunk that
-    exhausts device memory is halved (to a mesh-axis multiple) and
-    retried — the memory analog of run_consensus_batch's
-    capacity-escalation ladder, catching the data-dependent
-    candidate-product blowups the static estimate cannot see.
+    fixed shape -> one compile, many executions.
+
+    Failures walk the runtime ladder (docs/robustness.md): a chunk
+    that exhausts device memory is halved to a mesh-axis multiple and
+    retried (the memory analog of run_consensus_batch's
+    capacity-escalation ladder); in lenient mode (``strict=False``)
+    other errors get bounded-backoff transient retries, a chunk whose
+    ladder is exhausted falls back to per-micrograph execution, and a
+    micrograph that STILL fails is quarantined (recorded in
+    ``outcomes``/``journal``) instead of killing the run.  Strict
+    mode preserves the historical fail-fast contract: only the OOM
+    halving rung runs, everything else raises.
 
     Args:
         extra_device_outputs: optional ``f(batch) -> pytree`` of
@@ -1389,6 +1575,13 @@ def iter_consensus_chunks(
             fetched packed output array in the ``extras`` slot — the
             BOX-writing path consumes it with zero further transfers.
             Mutually exclusive with ``fetch``/``extra_device_outputs``.
+        strict: fail fast on any non-OOM error (and on OOM at the
+            chunk floor) instead of walking the lenient ladder.
+        policy: :class:`RetryPolicy` for the lenient rungs.
+        outcomes: :class:`ChunkOutcomes` collecting per-micrograph
+            ladder status / quarantine records for the caller.
+        journal: optional :class:`RunJournal` receiving ladder events
+            and quarantine entries as they happen.
 
     Yields:
         ``(part, batch, result, extras, seconds)`` per chunk, where
@@ -1401,10 +1594,86 @@ def iter_consensus_chunks(
         raise ValueError(
             "packed is mutually exclusive with fetch/extra_device_outputs"
         )
+    policy = policy or DEFAULT_POLICY
+    if outcomes is None:
+        outcomes = ChunkOutcomes()
     k = len(loaded[0][1])
     nb = bucket_size(max(bs.n for _, sets in loaded for bs in sets))
     chunk = _auto_chunk(len(loaded), k, nb, n_dev)
+
+    def _execute(cbatch, mesh_flag):
+        """One batch attempt; returns (result, extras) with the
+        shared fetch/packed handling."""
+        with annotate("consensus_batch"):
+            res = run_consensus_batch(
+                cbatch,
+                box_size,
+                threshold=threshold,
+                max_neighbors=max_neighbors,
+                use_mesh=mesh_flag,
+                spatial=spatial,
+                solver=solver,
+                use_pallas=use_pallas,
+                packed_probe=packed,
+            )
+            if packed:
+                # the escalation check already fetched everything
+                # the writer needs — no further device transfers
+                return res
+            extras = (
+                extra_device_outputs(cbatch)
+                if extra_device_outputs is not None
+                else None
+            )
+            if fetch:
+                # one packed transfer for the whole result (a tree
+                # device_get serializes ~10 round trips); extras (CC
+                # labels) remain a second fetch only when requested
+                res = _unpack_full_result(
+                    np.asarray(_pack_full_result(res)), k
+                )
+                if extras is not None:
+                    extras = jax.device_get(extras)
+            else:
+                jax.block_until_ready(res.picked)
+            return res, extras
+
+    def _fallback(part):
+        """Per-micrograph rung: isolate each micrograph of a failed
+        chunk; persistent failures quarantine instead of raising."""
+        for name, sets in part:
+            mkey = f"mic:{name}"
+            for attempt in range(policy.max_retries + 1):
+                t1 = time.time()
+                try:
+                    faults.inject("oom", mkey)
+                    faults.inject("io", mkey)
+                    b1 = pad_batch(
+                        [(name, sets)],
+                        pad_micrographs_to=1,
+                        capacity=nb,
+                    )
+                    res1, extras1 = _execute(b1, False)
+                except Exception as e:  # noqa: BLE001 — ladder rung
+                    if attempt < policy.max_retries:
+                        time.sleep(policy.backoff(attempt + 1))
+                        continue
+                    info = error_info(e, kind=classify_error(e))
+                    outcomes.quarantined[name] = info
+                    if journal is not None:
+                        journal.record(
+                            name, "quarantined",
+                            error=info, stage="consensus",
+                        )
+                    break
+                outcomes.mark([name], "degraded")
+                yield [(name, sets)], b1, res1, extras1, (
+                    time.time() - t1
+                )
+                break
+
     i = 0
+    attempts = 0  # same-size transient retries on the current chunk
     while i < len(loaded):
         single = chunk >= len(loaded)
         part = loaded[i : i + chunk]
@@ -1413,44 +1682,15 @@ def iter_consensus_chunks(
             pad_micrographs_to=n_dev if single else chunk,
             capacity=nb,
         )
+        ckey = f"chunk:{part[0][0]}:{len(part)}"
         t1 = time.time()
         try:
-            with annotate("consensus_batch"):
-                res = run_consensus_batch(
-                    cbatch,
-                    box_size,
-                    threshold=threshold,
-                    max_neighbors=max_neighbors,
-                    use_mesh=use_mesh,
-                    spatial=spatial,
-                    solver=solver,
-                    use_pallas=use_pallas,
-                    packed_probe=packed,
-                )
-                if packed:
-                    # the escalation check already fetched everything
-                    # the writer needs — no further device transfers
-                    res, extras = res
-                else:
-                    extras = (
-                        extra_device_outputs(cbatch)
-                        if extra_device_outputs is not None
-                        else None
-                    )
-                    if fetch:
-                        # one packed transfer for the whole result (a
-                        # tree device_get serializes ~10 round trips);
-                        # extras (CC labels) remain a second fetch
-                        # only when requested
-                        res = _unpack_full_result(
-                            np.asarray(_pack_full_result(res)), k
-                        )
-                        if extras is not None:
-                            extras = jax.device_get(extras)
-                    else:
-                        jax.block_until_ready(res.picked)
-        except Exception as e:  # noqa: BLE001 — filtered to OOM below
-            if _is_oom_error(e) and chunk > n_dev:
+            faults.inject("oom", ckey)
+            faults.inject("io", ckey)
+            res, extras = _execute(cbatch, use_mesh)
+        except Exception as e:  # noqa: BLE001 — routed to the ladder
+            kind = classify_error(e)
+            if kind == "oom" and chunk > n_dev:
                 chunk = max(
                     -(-(chunk // 2) // n_dev) * n_dev, n_dev
                 )
@@ -1458,7 +1698,38 @@ def iter_consensus_chunks(
                     "consensus chunk exhausted device memory; "
                     f"retrying at {chunk} micrographs/chunk"
                 )
+                if journal is not None:
+                    journal.record_event(
+                        "chunk_halved", chunk=chunk,
+                        error=str(e)[:200],
+                    )
+                outcomes.mark((n for n, _ in part), "retried")
+                attempts = 0
                 continue
-            raise
+            if strict:
+                raise
+            if kind != "oom" and attempts < policy.max_retries:
+                attempts += 1
+                delay = policy.backoff(attempts)
+                if journal is not None:
+                    journal.record_event(
+                        "chunk_retry", attempt=attempts,
+                        backoff_s=delay, error=str(e)[:200],
+                    )
+                outcomes.mark((n for n, _ in part), "retried")
+                time.sleep(delay)
+                continue
+            # chunk ladder exhausted -> isolate micrographs
+            if journal is not None:
+                journal.record_event(
+                    "per_micrograph_fallback",
+                    names=[n for n, _ in part],
+                    error=str(e)[:200],
+                )
+            yield from _fallback(part)
+            i += len(part)
+            attempts = 0
+            continue
+        attempts = 0
         yield part, cbatch, res, extras, time.time() - t1
         i += len(part)

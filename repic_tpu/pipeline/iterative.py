@@ -302,8 +302,17 @@ def consensus_round(
     state: IterativeState,
     *,
     num_particles: int | None = None,
+    strict: bool = False,
 ) -> dict:
-    """Fused consensus per split; returns {split: consensus_dir}."""
+    """Fused consensus per split; returns {split: consensus_dir}.
+
+    Runs under the fault-tolerant runtime with ``resume=True``: a
+    round interrupted mid-consensus continues from its journal on
+    the next invocation instead of recomputing every micrograph, and
+    (lenient default) a picker that emitted one malformed BOX file
+    quarantines that micrograph rather than sinking the round.
+    Quarantines are surfaced in the run log.
+    """
     out = {}
     for split, pdir in pred_dirs.items():
         cdir = os.path.join(round_dir, "consensus", split)
@@ -314,12 +323,20 @@ def consensus_round(
             box_size,
             num_particles=num_particles,
             use_mesh=False,
+            resume=True,
+            strict=strict,
         )
         state.log(
             f"consensus/{split}: {stats.get('num_cliques', 0)} "
             f"cliques over {stats['micrographs']} micrographs "
             f"({time.time() - t0:.1f}s)"
         )
+        if stats.get("quarantined"):
+            state.log(
+                f"consensus/{split}: QUARANTINED "
+                f"{sorted(stats['quarantined'])} "
+                "(see _journal.jsonl in the consensus dir)"
+            )
         out[split] = cdir
     return out
 
@@ -349,6 +366,7 @@ def run_iterative(
     seed: int = 0,
     picker_overrides: dict | None = None,
     resume: bool = True,
+    strict: bool = False,
 ) -> IterativeState:
     """The full iterative ensemble pipeline (run.sh's control flow).
 
@@ -371,6 +389,9 @@ def run_iterative(
             from its last completed round (state.json is saved after
             every round; the reference's run.sh only leaves a manual
             resume hint, run.sh:228-229).
+        strict: fail fast on bad inputs in the consensus stages
+            instead of the runtime's default lenient
+            quarantine-and-continue behavior.
     """
     os.makedirs(out_dir, exist_ok=True)
     state = IterativeState(out_dir=out_dir)
@@ -453,6 +474,7 @@ def run_iterative(
                 box_size,
                 state,
                 num_particles=exp_particles or None,
+                strict=strict,
             )
         _finish_round(
             state, pickers, consensus_dirs, round_dir,
@@ -488,6 +510,7 @@ def run_iterative(
             box_size,
             state,
             num_particles=exp_particles or None,
+            strict=strict,
         )
         _finish_round(
             state, pickers, consensus_dirs, round_dir,

@@ -53,6 +53,11 @@ class BuiltinPicker:
     # the HBM traffic (params/checkpoints stay float32) — the bulk
     # whole-dataset picking rounds are where the traffic saving lands
     compute_dtype: str = "float32"
+    # lenient=True: a micrograph whose read/pick fails gets an empty
+    # BOX file and a structured warning instead of killing the whole
+    # prediction round (the picker-stage analog of the consensus
+    # runtime's quarantine; docs/robustness.md)
+    lenient: bool = False
 
     def predict(self, mrc_dir: str, out_box_dir: str) -> int:
         """Pick every micrograph; returns total particles written."""
@@ -71,25 +76,48 @@ class BuiltinPicker:
                 "checkpoint or run in semi-automatic mode "
                 "(round 0 needs either a pre-trained model or seed labels)"
             )
+        from repic_tpu.runtime import faults
+
         params, meta = load_checkpoint(self.model_path)
         os.makedirs(out_box_dir, exist_ok=True)
         total = 0
         for path in sorted(glob.glob(os.path.join(mrc_dir, "*.mrc"))):
-            raw = mrc_io.read_mrc(path).astype(np.float32)
-            if raw.ndim == 3:
-                raw = raw[0]
-            coords = pick_micrograph(
-                params,
-                raw,
-                self.particle_size,
-                mode=self.mode,
-                norm=meta.get("patch_norm", "reference"),
-                arch=meta.get("arch", self.arch),
-                dtype=self.compute_dtype,
-            )
-            coords = coords[coords[:, 2] >= self.threshold]
             stem = os.path.splitext(os.path.basename(path))[0]
             out = os.path.join(out_box_dir, stem + ".box")
+            try:
+                faults.inject("io", path)
+                raw = mrc_io.read_mrc(path).astype(np.float32)
+                if raw.ndim == 3:
+                    raw = raw[0]
+                coords = pick_micrograph(
+                    params,
+                    raw,
+                    self.particle_size,
+                    mode=self.mode,
+                    norm=meta.get("patch_norm", "reference"),
+                    arch=meta.get("arch", self.arch),
+                    dtype=self.compute_dtype,
+                )
+            except (OSError, ValueError) as e:
+                if not self.lenient:
+                    # fail fast, but with the offending path attached
+                    # (a bare ValueError from deep inside the MRC
+                    # parser is not actionable at directory scale)
+                    raise PickerError(
+                        f"{self.name}: failed to pick {path}: "
+                        f"{type(e).__name__}: {e}"
+                    ) from e
+                import warnings
+
+                warnings.warn(
+                    f"{self.name}: quarantined micrograph {stem} "
+                    f"(empty BOX written): {type(e).__name__}: {e}",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                write_empty_box(out)
+                continue
+            coords = coords[coords[:, 2] >= self.threshold]
             if len(coords) == 0:
                 # empty placeholder, reference convention
                 # (run_topaz.sh:40-48, get_cliques.py:124-130)

@@ -1,0 +1,44 @@
+"""Fault-tolerant consensus runtime.
+
+Partial failure is the common case at directory scale (one corrupt
+BOX file, one transient device OOM, one preemption), so execution is
+wrapped in a runtime that journals per-micrograph outcomes, resumes
+interrupted runs, quarantines bad inputs instead of dying, degrades
+gracefully under budget pressure, and proves all of it with a
+deterministic fault-injection harness:
+
+* :mod:`repic_tpu.runtime.journal` — JSONL run journal + manifest,
+  the ``--resume`` substrate;
+* :mod:`repic_tpu.runtime.ladder` — retry/degradation policy (chunk
+  ladder + solver ladder exact -> lp -> greedy);
+* :mod:`repic_tpu.runtime.faults` — deterministic fault injection
+  (``REPIC_TPU_FAULTS`` / :func:`~repic_tpu.runtime.faults.fault_plan`);
+* :mod:`repic_tpu.runtime.atomic` — crash-safe artifact writes.
+
+Everything here is stdlib-only at import time (jax/numpy load lazily
+inside functions), so host-only commands stay free of XLA startup.
+"""
+
+from repic_tpu.runtime.atomic import atomic_write
+from repic_tpu.runtime.journal import RunJournal, error_info, read_journal
+from repic_tpu.runtime.ladder import (
+    DEFAULT_POLICY,
+    ChunkOutcomes,
+    RetryPolicy,
+    classify_error,
+    is_oom_error,
+    solve_host_ladder,
+)
+
+__all__ = [
+    "atomic_write",
+    "RunJournal",
+    "error_info",
+    "read_journal",
+    "DEFAULT_POLICY",
+    "ChunkOutcomes",
+    "RetryPolicy",
+    "classify_error",
+    "is_oom_error",
+    "solve_host_ladder",
+]

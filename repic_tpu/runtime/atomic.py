@@ -1,0 +1,42 @@
+"""Crash-safe file writes (tmp + ``os.replace``).
+
+Every artifact writer in the pipeline (BOX files, consensus TSVs,
+runtime tables, the run manifest) goes through :func:`atomic_write`:
+the content lands in a same-directory temporary file and is published
+with one atomic ``os.replace``, so an interrupted run never leaves a
+torn half-written output — the reader either sees the previous
+complete file or the new complete file, never a prefix.  This is the
+atomic-write rung of the fault-tolerant runtime (docs/robustness.md).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+
+@contextlib.contextmanager
+def atomic_write(path: str, mode: str = "wt"):
+    """Open ``path`` for writing via a same-directory temp file.
+
+    On clean exit the temp file is flushed, fsynced and atomically
+    renamed onto ``path``; on any exception it is removed and the
+    previous ``path`` content (if any) is left untouched.  ``mode``
+    must be a write mode ("wt"/"wb") — append modes make no sense
+    through a replace.
+    """
+    if "a" in mode or "r" in mode or "+" in mode:
+        raise ValueError(f"atomic_write requires a write mode, got {mode!r}")
+    tmp = f"{path}.tmp{os.getpid()}"
+    f = open(tmp, mode)
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+    except BaseException:
+        f.close()
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+    f.close()
+    os.replace(tmp, path)

@@ -1,0 +1,178 @@
+"""Deterministic fault-injection harness for the consensus runtime.
+
+Production fault handling (retry ladders, quarantine, resume) is only
+trustworthy if every rung is exercised by tests — real OOMs and
+corrupt inputs are too rare and too nondeterministic to rely on.  This
+module lets tests (and operators, via ``REPIC_TPU_FAULTS``) plant
+failures at named sites in the pipeline:
+
+==============  ====================================================
+site            raised at the matching call site
+==============  ====================================================
+``io``          ``OSError`` — transient I/O failure
+``oom``         ``RuntimeError`` whose text matches the runtime's
+                OOM classifier (``RESOURCE_EXHAUSTED``)
+``corrupt_box`` ``ValueError`` — malformed BOX content (surfaces as
+                :class:`repic_tpu.utils.box_io.BoxParseError`)
+``solver_budget`` no exception — the solver ladder polls
+                :func:`check` and treats a firing as budget
+                exhaustion of that rung
+==============  ====================================================
+
+Injection is purely count-based (no randomness, no clocks): a
+:class:`Fault` fires at the first ``times`` call sites whose key
+contains its ``key`` substring, then goes inert.  The same plan
+against the same workload therefore fails at exactly the same points
+— tests assert on the fired log.
+
+Plans install either through the :func:`fault_plan` context manager
+(tests), or process-wide from the ``REPIC_TPU_FAULTS`` environment
+variable (CLI runs; see :func:`install_from_env`), with specs of the
+form ``site[:key[:times]]``, comma-separated::
+
+    REPIC_TPU_FAULTS='corrupt_box:mic_002,oom::1' repic-tpu consensus ...
+
+When no plan is installed every hook is a no-op (one attribute read).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from dataclasses import dataclass, field
+
+_UNLIMITED = ("inf", "*")
+
+
+@dataclass
+class Fault:
+    """One planted failure: fires at the first ``times`` call sites
+    of ``site`` whose key contains the ``key`` substring."""
+
+    site: str
+    key: str | None = None  # substring match; None matches any key
+    times: int | None = 1   # None = unlimited
+    fired: int = field(default=0, compare=False)
+
+    def matches(self, site: str, key) -> bool:
+        if self.site != site:
+            return False
+        if self.times is not None and self.fired >= self.times:
+            return False
+        return self.key is None or self.key in str(key)
+
+
+_PLAN: list[Fault] = []
+_FIRED: list[tuple[str, str]] = []  # (site, call-site key) in order
+_LOCK = threading.Lock()
+
+
+def parse_spec(spec: str) -> Fault:
+    """``site[:key[:times]]`` -> :class:`Fault`.
+
+    An empty or ``*`` key matches any call site; times defaults to 1,
+    with ``inf``/``*`` meaning unlimited.
+    """
+    parts = spec.strip().split(":")
+    if not parts[0]:
+        raise ValueError(f"empty fault site in spec {spec!r}")
+    site = parts[0]
+    if len(parts) > 2:
+        key_tok, times_tok = ":".join(parts[1:-1]), parts[-1]
+    else:
+        key_tok = parts[1] if len(parts) == 2 else ""
+        times_tok = ""
+    times: int | None = 1
+    if times_tok:
+        times = None if times_tok in _UNLIMITED else int(times_tok)
+    key = None if key_tok in ("", "*") else key_tok
+    return Fault(site=site, key=key, times=times)
+
+
+def active() -> bool:
+    """Cheap guard: is any fault plan installed?"""
+    return bool(_PLAN)
+
+
+def check(site: str, key=None) -> bool:
+    """Consume one matching firing; returns True when a fault fired.
+
+    Thread-safe (the host-side BOX parse runs in a thread pool), and
+    deterministic: matching is first-spec-wins in installation order.
+    """
+    if not _PLAN:
+        return False
+    with _LOCK:
+        for f in _PLAN:
+            if f.matches(site, key):
+                f.fired += 1
+                _FIRED.append((site, str(key)))
+                return True
+    return False
+
+
+def inject(site: str, key=None) -> None:
+    """Raise the site's canonical exception when a fault fires."""
+    if not check(site, key):
+        return
+    if site == "oom":
+        raise RuntimeError(
+            f"RESOURCE_EXHAUSTED: out of memory (injected fault at {key})"
+        )
+    if site == "io":
+        raise OSError(f"injected I/O fault at {key}")
+    if site == "corrupt_box":
+        raise ValueError(f"injected corrupt BOX content at {key}")
+    raise RuntimeError(f"injected fault [{site}] at {key}")
+
+
+def fired_log() -> tuple[tuple[str, str], ...]:
+    """The ordered (site, key) log of every fault fired so far."""
+    with _LOCK:
+        return tuple(_FIRED)
+
+
+def install(*specs: "str | Fault") -> list[Fault]:
+    """Replace the active plan (specs or Fault objects); clears the
+    fired log.  Prefer :func:`fault_plan` in tests."""
+    plan = [s if isinstance(s, Fault) else parse_spec(s) for s in specs]
+    with _LOCK:
+        _PLAN[:] = plan
+        _FIRED.clear()
+    return plan
+
+
+def clear() -> None:
+    with _LOCK:
+        _PLAN.clear()
+        _FIRED.clear()
+
+
+@contextlib.contextmanager
+def fault_plan(*specs: "str | Fault"):
+    """Install a plan for the duration of a with-block, restoring the
+    previous plan (and fired log) on exit."""
+    with _LOCK:
+        prev_plan, prev_fired = list(_PLAN), list(_FIRED)
+    try:
+        yield install(*specs)
+    finally:
+        with _LOCK:
+            _PLAN[:] = prev_plan
+            _FIRED[:] = prev_fired
+
+
+def install_from_env(environ=None) -> list[Fault]:
+    """Install a process-wide plan from ``REPIC_TPU_FAULTS``.
+
+    Called once by the CLI dispatcher so operators can rehearse
+    failure handling on real runs (e.g. chaos-test a directory run)
+    without touching code.  No-op when the variable is unset/empty.
+    """
+    import os
+
+    env = os.environ if environ is None else environ
+    raw = env.get("REPIC_TPU_FAULTS", "")
+    if not raw.strip():
+        return []
+    return install(*[s for s in raw.split(",") if s.strip()])

@@ -1,0 +1,198 @@
+"""Run journal + manifest: per-micrograph outcomes and ``--resume``.
+
+A directory-scale consensus run appends one JSON line per processed
+micrograph to ``_journal.jsonl`` in the output directory, recording
+the outcome (``ok`` / ``retried`` / ``degraded`` / ``quarantined`` /
+``skipped``), wall time, the solver that actually ran, and — for
+quarantined inputs — a structured error.  A sibling ``_manifest.json``
+pins the run configuration (flags plus the input micrograph name
+set), so ``--resume`` can tell "same run, continue" apart from "a
+different run landed in the same directory".
+
+Resume contract (docs/robustness.md):
+
+* completed entries (latest status ``ok``/``retried``/``degraded``/
+  ``skipped``) whose output file still exists are NOT re-processed;
+* ``quarantined`` entries and micrographs with no journal entry or a
+  missing output ARE re-processed;
+* a manifest mismatch (different flags or input name set) discards
+  the journal and restarts the run from scratch.
+
+The journal is append-only and flushed per record, so a crash loses
+at most the in-flight micrograph; outputs themselves are atomic
+(:mod:`repic_tpu.runtime.atomic`), so a recorded completion always
+points at a complete file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from repic_tpu.runtime.atomic import atomic_write
+
+JOURNAL_NAME = "_journal.jsonl"
+MANIFEST_NAME = "_manifest.json"
+
+STATUS_OK = "ok"
+STATUS_RETRIED = "retried"        # succeeded after >= 1 retry
+STATUS_DEGRADED = "degraded"      # succeeded on a fallback rung
+STATUS_QUARANTINED = "quarantined"
+STATUS_SKIPPED = "skipped"        # empty output (missing picker input)
+DONE_STATUSES = frozenset(
+    (STATUS_OK, STATUS_RETRIED, STATUS_DEGRADED, STATUS_SKIPPED)
+)
+
+
+def error_info(exc: BaseException, **extra) -> dict:
+    """Structured, JSON-safe description of a failure for the journal."""
+    info = {"type": type(exc).__name__, "message": str(exc)[:500]}
+    info.update(extra)
+    return info
+
+
+class RunJournal:
+    """Append-only JSONL journal with a config-pinning manifest."""
+
+    def __init__(self, out_dir: str):
+        self.out_dir = out_dir
+        self.path = os.path.join(out_dir, JOURNAL_NAME)
+        self.manifest_path = os.path.join(out_dir, MANIFEST_NAME)
+        self.resumed = False
+        self._latest: dict[str, dict] = {}
+        self._events: list[dict] = []
+        self._fh = None
+
+    # -- lifecycle ----------------------------------------------------
+
+    @classmethod
+    def open(cls, out_dir: str, config: dict, *, resume: bool = False):
+        """Open (or resume) the journal for a run configuration.
+
+        ``config`` must be JSON-serializable; it is round-tripped
+        through JSON before comparison so tuple-vs-list never causes
+        a spurious mismatch.
+        """
+        j = cls(out_dir)
+        config = json.loads(json.dumps(config))
+        os.makedirs(out_dir, exist_ok=True)
+        prev = j._read_manifest()
+        if resume and prev is not None and prev.get("config") == config:
+            j.resumed = True
+            j._load_entries()
+        elif os.path.exists(j.path):
+            os.unlink(j.path)  # stale journal from a different run
+        with atomic_write(j.manifest_path) as f:
+            json.dump({"config": config, "created": time.time()}, f,
+                      indent=2)
+        return j
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- writes -------------------------------------------------------
+
+    def record(self, name: str, status: str, **fields) -> dict:
+        """Append one micrograph outcome (flushed immediately)."""
+        entry = {"name": name, "status": status, "ts": time.time()}
+        entry.update(fields)
+        self._append(entry)
+        self._latest[name] = entry
+        return entry
+
+    def record_event(self, event: str, **fields) -> dict:
+        """Append a run-level event (chunk retry, chunk halving, ...)."""
+        entry = {"event": event, "ts": time.time()}
+        entry.update(fields)
+        self._append(entry)
+        self._events.append(entry)
+        return entry
+
+    def _append(self, entry: dict) -> None:
+        if self._fh is None:
+            self._fh = open(self.path, "at")
+        self._fh.write(json.dumps(entry) + "\n")
+        self._fh.flush()
+
+    # -- reads --------------------------------------------------------
+
+    def latest(self) -> dict[str, dict]:
+        """Latest entry per micrograph name (events excluded)."""
+        return dict(self._latest)
+
+    def events(self) -> list[dict]:
+        return list(self._events)
+
+    def done_names(self) -> set[str]:
+        """Names whose latest status counts as complete (quarantined
+        entries are deliberately NOT done — resume retries them)."""
+        return {
+            n for n, e in self._latest.items()
+            if e.get("status") in DONE_STATUSES
+        }
+
+    def quarantined(self) -> dict[str, dict]:
+        return {
+            n: e for n, e in self._latest.items()
+            if e.get("status") == STATUS_QUARANTINED
+        }
+
+    def summary(self) -> dict:
+        """Status -> count over the latest entry of every micrograph."""
+        out: dict[str, int] = {}
+        for e in self._latest.values():
+            s = e.get("status", "unknown")
+            out[s] = out.get(s, 0) + 1
+        return out
+
+    # -- internals ----------------------------------------------------
+
+    def _read_manifest(self):
+        try:
+            with open(self.manifest_path) as f:
+                data = json.load(f)
+            return data if isinstance(data, dict) else None
+        except (OSError, ValueError):
+            return None
+
+    def _load_entries(self) -> None:
+        try:
+            with open(self.path) as f:
+                lines = f.readlines()
+        except OSError:
+            return
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entry = json.loads(line)
+            except ValueError:
+                continue  # torn trailing line from a crash
+            if "name" in entry:
+                self._latest[entry["name"]] = entry
+            elif "event" in entry:
+                self._events.append(entry)
+
+
+def read_journal(out_dir: str) -> list[dict]:
+    """All journal entries of a finished run (test/inspection helper)."""
+    path = os.path.join(out_dir, JOURNAL_NAME)
+    entries = []
+    if not os.path.exists(path):
+        return entries  # no entries recorded (or journal discarded)
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                entries.append(json.loads(line))
+    return entries

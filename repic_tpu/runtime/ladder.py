@@ -1,0 +1,186 @@
+"""Retry / degradation ladder for directory-scale consensus.
+
+One policy object and one error classifier subsume the previously
+scattered failure handling (the ad-hoc OOM halving in
+``pipeline/consensus.py`` and the single-shot solver fallbacks):
+
+* **compute ladder** (driven by ``iter_consensus_chunks``):
+  transient-retry with bounded backoff -> shrink the micrograph chunk
+  (OOM halving, down to the mesh axis) -> per-micrograph fallback ->
+  quarantine.  Strict mode stops the ladder at the first
+  non-recoverable rung and raises (the historical fail-fast
+  behavior); lenient mode walks every rung so one bad micrograph
+  cannot kill a 10k-micrograph run.
+
+* **solver ladder** (:func:`solve_host_ladder`): an exact-solve
+  time/node budget that degrades ``solve_exact`` ->
+  ``solve_lp_rounding`` -> ``solve_greedy``, returning which rung
+  actually produced the packing so the journal can record the
+  degradation.  Mirrors budget-pressure degradation in large solver
+  stacks (DuaLip-GPU tech report) rather than failing the run.
+
+Fault-injection hooks (:mod:`repic_tpu.runtime.faults`) cover every
+rung: ``oom``/``io`` fire in the chunk loop, ``solver_budget`` makes
+a named rung report exhaustion.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from repic_tpu.runtime import faults
+
+
+def is_oom_error(e: BaseException) -> bool:
+    """Device/host allocator exhaustion, by message (XLA raises plain
+    RuntimeError; RESOURCE_EXHAUSTED is its status-code spelling)."""
+    s = str(e).lower()
+    return "out of memory" in s or "resource_exhausted" in s
+
+
+def classify_error(e: BaseException) -> str:
+    """``oom`` | ``io`` | ``error`` — picks the ladder entry rung."""
+    if is_oom_error(e):
+        return "oom"
+    if isinstance(e, OSError):
+        return "io"
+    return "error"
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded-backoff retry budget for transient failures."""
+
+    max_retries: int = 2          # same-configuration re-attempts
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 2.0
+
+    def __post_init__(self):
+        # A negative budget would make the fallback loop run ZERO
+        # attempts and silently drop micrographs — reject it here
+        # rather than at every range(max_retries + 1) site.
+        if self.max_retries < 0:
+            raise ValueError(
+                f"max_retries must be >= 0, got {self.max_retries}"
+            )
+
+    def backoff(self, attempt: int) -> float:
+        """Exponential backoff for the given 1-based attempt, capped."""
+        return min(
+            self.backoff_cap_s,
+            self.backoff_base_s * (2.0 ** max(attempt - 1, 0)),
+        )
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+
+@dataclass
+class ChunkOutcomes:
+    """Per-run ladder bookkeeping, filled by the chunk iterator and
+    read back by the journaling writer."""
+
+    status: dict = None       # name -> retried|degraded (default ok)
+    quarantined: dict = None  # name -> structured error info
+    solver: dict = None       # name -> solver rung that actually ran
+
+    def __post_init__(self):
+        if self.status is None:
+            self.status = {}
+        if self.quarantined is None:
+            self.quarantined = {}
+        if self.solver is None:
+            self.solver = {}
+
+    def mark(self, names, status: str) -> None:
+        """Escalate the recorded status (degraded wins over retried)."""
+        for n in names:
+            if status == "retried" and self.status.get(n) == "degraded":
+                continue
+            self.status[n] = status
+
+
+# Degradation order per requested solver; every ladder ends on greedy,
+# which cannot exhaust a budget.
+SOLVER_LADDER = {
+    "exact": ("exact", "lp", "greedy"),
+    "lp": ("lp", "greedy"),
+    "greedy": ("greedy",),
+}
+
+
+def solve_host_ladder(
+    member_vertex,
+    w,
+    num_vertices: int,
+    *,
+    solver: str = "exact",
+    budget_s: float | None = None,
+    node_limit: int = 2_000_000,
+):
+    """Host-side packing solve with budgeted degradation.
+
+    Args:
+        member_vertex: ``(C, K)`` int vertex ids (valid cliques only).
+        w: ``(C,)`` weights.
+        num_vertices: vertex-space size.
+        solver: requested rung (``exact``/``lp``/``greedy``).
+        budget_s: wall-clock budget for the exact rung; ``None`` =
+            unbudgeted.  The node_limit budget applies either way.
+
+    Returns:
+        ``(picked, used)`` — bool mask over the C cliques and the
+        rung that produced it.  ``used != solver`` means degradation.
+    """
+    import numpy as np
+
+    from repic_tpu.ops.solver import (
+        SolverBudgetExceeded,
+        solve_exact,
+        solve_greedy,
+        solve_lp_rounding,
+    )
+
+    member_vertex = np.asarray(member_vertex)
+    w = np.asarray(w)
+    C = len(w)
+    rungs = SOLVER_LADDER[solver]
+    if C == 0:
+        return np.zeros(0, bool), rungs[0]
+    for rung in rungs[:-1]:
+        if faults.check("solver_budget", rung):
+            continue  # injected budget exhaustion of this rung
+        try:
+            if rung == "exact":
+                return (
+                    solve_exact(
+                        member_vertex,
+                        w.astype(np.float64),
+                        node_limit=node_limit,
+                        budget_s=budget_s,
+                    ),
+                    rung,
+                )
+            picked = _solve_device(
+                solve_lp_rounding, member_vertex, w, num_vertices
+            )
+            return picked, rung
+        except SolverBudgetExceeded:
+            continue
+    # terminal rung: greedy always terminates and takes no budget, so
+    # the ladder cannot fail — there is no injection hook here.
+    picked = _solve_device(solve_greedy, member_vertex, w, num_vertices)
+    return picked, rungs[-1]
+
+
+def _solve_device(fn, member_vertex, w, num_vertices):
+    import jax.numpy as jnp
+    import numpy as np
+
+    picked = fn(
+        jnp.asarray(np.asarray(member_vertex), jnp.int32),
+        jnp.asarray(np.asarray(w, np.float32)),
+        jnp.ones(len(w), bool),
+        int(num_vertices),
+    )
+    return np.asarray(picked)

@@ -25,6 +25,26 @@ from typing import NamedTuple, Sequence
 
 import numpy as np
 
+from repic_tpu.runtime import faults
+from repic_tpu.runtime.atomic import atomic_write
+
+
+class BoxParseError(ValueError):
+    """A BOX file could not be read or parsed.
+
+    Always carries the offending ``path`` (and the underlying cause
+    as ``__cause__``), so quarantine records in the run journal are
+    actionable — "which file, and why" — instead of a bare
+    ``ValueError`` from deep inside a parser tier.
+    """
+
+    def __init__(self, path: str, cause: BaseException):
+        super().__init__(
+            f"failed to read BOX file {path}: "
+            f"{type(cause).__name__}: {cause}"
+        )
+        self.path = path
+
 
 class BoxSet(NamedTuple):
     """Particles of one picker on one micrograph (host-side, ragged)."""
@@ -56,17 +76,31 @@ def read_box(path: str) -> BoxSet:
     semantic specification — for anything the faster tiers cannot
     digest (odd headers, ragged rows, no toolchain).  The 50k-row
     stress files and 1024-micrograph batches are host-parse bound
-    without the fast tiers."""
+    without the fast tiers.
+
+    Failures are deliberately narrow: only the parse/IO error family
+    (plus a missing-pandas ``ImportError``) moves a file down the
+    tier chain, and a file no tier can digest raises
+    :class:`BoxParseError` carrying the path — anything else (a
+    genuine bug) propagates loudly instead of being retried on a
+    slower tier."""
+    faults.inject("io", path)  # transient-I/O injection site (OSError)
     try:
-        arr = _read_box_native(path)
-        if arr is not None:
-            return arr
-    except Exception:
-        pass
-    try:
-        return _read_box_fast(path)
-    except Exception:
-        return _read_box_slow(path)
+        faults.inject("corrupt_box", path)
+        try:
+            arr = _read_box_native(path)
+            if arr is not None:
+                return arr
+        except (OSError, ValueError, ImportError):
+            pass
+        try:
+            return _read_box_fast(path)
+        except (OSError, ValueError, ImportError, IndexError, KeyError):
+            return _read_box_slow(path)
+    except (OSError, ValueError, IndexError) as e:
+        # ValueError covers UnicodeDecodeError and pandas parser
+        # errors; IndexError is the slow loop on a one-token row.
+        raise BoxParseError(path, e) from e
 
 
 def _read_box_native(path: str) -> BoxSet | None:
@@ -189,7 +223,11 @@ def write_box(
     num_particles: int | None = None,
     sort: bool = True,
 ) -> None:
-    """Write a consensus BOX file in the reference's output format."""
+    """Write a consensus BOX file in the reference's output format.
+
+    Crash-safe: content lands in a temp file and is published with
+    one atomic rename, so an interrupted run never leaves a torn BOX
+    file behind (the resume path trusts any file that exists)."""
     xy = np.asarray(xy)
     weights = np.asarray(weights)
     order = (
@@ -204,7 +242,7 @@ def write_box(
     sizes = np.broadcast_to(
         np.asarray(box_size).reshape(-1), (len(weights),)
     )
-    with open(path, "wt") as o:
+    with atomic_write(path) as o:
         for i in order:
             bs = str(int(sizes[i]))
             o.write(
@@ -222,8 +260,9 @@ def write_box(
 
 
 def write_empty_box(path: str) -> None:
-    """Empty placeholder BOX file (reference: get_cliques.py:124-130)."""
-    with open(path, "wt"):
+    """Empty placeholder BOX file (reference: get_cliques.py:124-130),
+    published atomically like every other artifact."""
+    with atomic_write(path):
         pass
 
 

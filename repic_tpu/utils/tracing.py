@@ -70,9 +70,11 @@ class StageTimer:
         return {label: secs for label, secs in self.stages}
 
     def write_tsv(self, out_dir: str, name: str = "runtime.tsv") -> str:
+        from repic_tpu.runtime.atomic import atomic_write
+
         os.makedirs(out_dir, exist_ok=True)
         path = os.path.join(out_dir, name)
-        with open(path, "wt") as f:
+        with atomic_write(path) as f:
             for label, secs in self.stages:
                 f.write(f"{label}\t{secs:.6f}\n")
         return path

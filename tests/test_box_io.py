@@ -82,6 +82,62 @@ def test_roundtrip(tmp_path):
     np.testing.assert_allclose(sorted(bs.conf), [0.1, 0.9], rtol=1e-6)
 
 
+# --- structured parse errors + crash-safe writes --------------------
+
+
+def test_corrupt_file_raises_boxparseerror_with_path(tmp_path):
+    import pytest
+
+    p = tmp_path / "bad.box"
+    p.write_text("x y w h conf\nthis is not a number\n")
+    with pytest.raises(box_io.BoxParseError) as ei:
+        box_io.read_box(str(p))
+    assert ei.value.path == str(p)
+    assert str(p) in str(ei.value)  # actionable: names the file
+    assert isinstance(ei.value, ValueError)  # narrow, catchable family
+
+
+def test_one_token_row_raises_boxparseerror(tmp_path):
+    import pytest
+
+    p = tmp_path / "ragged.box"
+    p.write_text("10\n")
+    with pytest.raises(box_io.BoxParseError):
+        box_io.read_box(str(p))
+
+
+def test_binary_garbage_raises_boxparseerror(tmp_path):
+    import pytest
+
+    p = tmp_path / "bin.box"
+    p.write_bytes(bytes(range(256)) * 4)
+    with pytest.raises(box_io.BoxParseError):
+        box_io.read_box(str(p))
+
+
+def test_write_box_failure_keeps_previous_file(tmp_path):
+    """A writer crash mid-file must not tear an existing output
+    (write-to-temp + os.replace)."""
+    import pytest
+
+    p = tmp_path / "out.box"
+    p.write_text("ORIGINAL CONTENT\n")
+    xy = np.zeros((1, 2))  # one row of coords...
+    w = np.array([0.5, 0.7], np.float32)  # ...two weights -> IndexError
+    with pytest.raises(IndexError):
+        box_io.write_box(str(p), xy, w, 64)
+    assert p.read_text() == "ORIGINAL CONTENT\n"
+    assert [f.name for f in tmp_path.iterdir()] == ["out.box"]
+
+
+def test_write_empty_box_is_atomic_overwrite(tmp_path):
+    p = tmp_path / "e.box"
+    p.write_text("10 20 64 64 0.5\n")
+    box_io.write_empty_box(str(p))
+    assert p.read_text() == ""
+    assert [f.name for f in tmp_path.iterdir()] == ["e.box"]
+
+
 # --- native C++ parser tier (native/boxparse.cpp) -------------------
 
 CASES = {

@@ -1,0 +1,101 @@
+"""Fault-injection harness: spec parsing, determinism, read-site hooks.
+
+The harness is the proof substrate for every rung of the runtime
+ladder (docs/robustness.md), so its own semantics — count-based
+determinism, substring keying, plan scoping — are pinned here first.
+"""
+
+import numpy as np
+import pytest
+
+from repic_tpu.runtime import faults
+from repic_tpu.utils import box_io
+
+pytestmark = pytest.mark.faults
+
+
+def test_parse_spec_forms():
+    f = faults.parse_spec("oom")
+    assert (f.site, f.key, f.times) == ("oom", None, 1)
+    f = faults.parse_spec("io:mic_002")
+    assert (f.site, f.key, f.times) == ("io", "mic_002", 1)
+    f = faults.parse_spec("io:mic_002:3")
+    assert (f.site, f.key, f.times) == ("io", "mic_002", 3)
+    f = faults.parse_spec("oom::inf")
+    assert (f.site, f.key, f.times) == ("oom", None, None)
+    f = faults.parse_spec("oom:mic:a:2")  # keys may contain ':'
+    assert (f.site, f.key, f.times) == ("oom", "mic:a", 2)
+    f = faults.parse_spec("io:*")
+    assert f.key is None
+    with pytest.raises(ValueError):
+        faults.parse_spec(":key")
+
+
+def test_count_based_determinism():
+    with faults.fault_plan("oom:chunk:2"):
+        assert faults.check("oom", "chunk:a") is True
+        assert faults.check("oom", "other") is False  # key mismatch
+        assert faults.check("oom", "chunk:b") is True
+        assert faults.check("oom", "chunk:c") is False  # exhausted
+        assert faults.fired_log() == (
+            ("oom", "chunk:a"), ("oom", "chunk:b")
+        )
+    # plan scoping: inert outside the with-block
+    assert faults.check("oom", "chunk:z") is False
+    assert not faults.active()
+
+
+def test_inject_raises_canonical_exceptions():
+    with faults.fault_plan("oom", "io", "corrupt_box"):
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            faults.inject("oom", "site")
+        with pytest.raises(OSError, match="injected I/O"):
+            faults.inject("io", "site")
+        with pytest.raises(ValueError, match="corrupt BOX"):
+            faults.inject("corrupt_box", "site")
+        # all single-shot: second call is a no-op
+        faults.inject("oom", "site")
+
+
+def test_nested_plans_restore():
+    with faults.fault_plan("oom::inf"):
+        assert faults.check("oom", "x")
+        with faults.fault_plan("io"):
+            assert not faults.check("oom", "x")  # inner plan replaces
+            assert faults.check("io", "y")
+        assert faults.check("oom", "x")  # outer plan restored
+
+
+def test_install_from_env():
+    try:
+        plan = faults.install_from_env(
+            {"REPIC_TPU_FAULTS": "corrupt_box:mic_002, oom::1"}
+        )
+        assert [(f.site, f.key) for f in plan] == [
+            ("corrupt_box", "mic_002"), ("oom", None)
+        ]
+        assert faults.install_from_env({}) == []  # unset: no-op
+    finally:
+        faults.clear()
+
+
+def test_read_box_corrupt_injection_is_boxparseerror(tmp_path):
+    p = tmp_path / "mic_002.box"
+    p.write_text("10 20 64 64 0.5\n")
+    with faults.fault_plan("corrupt_box:mic_002"):
+        with pytest.raises(box_io.BoxParseError) as ei:
+            box_io.read_box(str(p))
+        assert ei.value.path == str(p)
+        assert "mic_002" in str(ei.value)
+        # single-shot: the retry parses fine
+        bs = box_io.read_box(str(p))
+        np.testing.assert_allclose(bs.xy, [[10, 20]])
+
+
+def test_read_box_io_injection_is_oserror(tmp_path):
+    p = tmp_path / "mic_007.box"
+    p.write_text("10 20 64 64 0.5\n")
+    with faults.fault_plan("io:mic_007"):
+        with pytest.raises(OSError, match="injected I/O"):
+            box_io.read_box(str(p))
+        assert box_io.read_box(str(p)).n == 1

@@ -1,0 +1,109 @@
+"""Run journal + manifest + atomic writes: the --resume substrate."""
+
+import json
+import os
+
+import pytest
+
+from repic_tpu.runtime.atomic import atomic_write
+from repic_tpu.runtime.journal import RunJournal, error_info, read_journal
+
+CFG = {"in_dir": "/data", "box_size": 64, "names": ["a", "b", "c"]}
+
+
+def test_record_latest_and_summary(tmp_path):
+    out = str(tmp_path / "run")
+    with RunJournal.open(out, CFG) as j:
+        j.record("a", "ok", wall_s=0.1, solver="greedy")
+        j.record("b", "quarantined", error={"type": "ValueError"})
+        j.record("b", "ok")  # reprocessed: latest wins
+        j.record_event("chunk_halved", chunk=4)
+        assert j.done_names() == {"a", "b"}
+        assert j.quarantined() == {}
+        assert j.summary() == {"ok": 2}
+        assert j.events()[0]["event"] == "chunk_halved"
+    entries = read_journal(out)
+    assert [e.get("name", e.get("event")) for e in entries] == [
+        "a", "b", "b", "chunk_halved"
+    ]
+
+
+def test_resume_same_config_loads_entries(tmp_path):
+    out = str(tmp_path / "run")
+    with RunJournal.open(out, CFG) as j:
+        j.record("a", "ok", out="a.box")
+        j.record("b", "quarantined", error=error_info(ValueError("x")))
+    with RunJournal.open(out, CFG, resume=True) as j2:
+        assert j2.resumed
+        assert j2.done_names() == {"a"}  # quarantined is NOT done
+        assert set(j2.quarantined()) == {"b"}
+        j2.record("b", "ok", out="b.box")
+        assert j2.done_names() == {"a", "b"}
+
+
+def test_resume_config_mismatch_discards_journal(tmp_path):
+    out = str(tmp_path / "run")
+    with RunJournal.open(out, CFG) as j:
+        j.record("a", "ok")
+    other = dict(CFG, box_size=128)
+    with RunJournal.open(out, other, resume=True) as j2:
+        assert not j2.resumed
+        assert j2.latest() == {}
+    # the stale journal file was dropped, not merged
+    assert read_journal(out) == []
+
+
+def test_no_resume_is_fresh_even_with_same_config(tmp_path):
+    out = str(tmp_path / "run")
+    with RunJournal.open(out, CFG) as j:
+        j.record("a", "ok")
+    with RunJournal.open(out, CFG, resume=False) as j2:
+        assert not j2.resumed and j2.latest() == {}
+
+
+def test_torn_trailing_line_is_tolerated(tmp_path):
+    out = str(tmp_path / "run")
+    with RunJournal.open(out, CFG) as j:
+        j.record("a", "ok")
+        path = j.path
+    with open(path, "at") as f:
+        f.write('{"name": "b", "status": "o')  # crash mid-write
+    with RunJournal.open(out, CFG, resume=True) as j2:
+        assert j2.done_names() == {"a"}
+
+
+def test_manifest_pins_config_json_roundtripped(tmp_path):
+    out = str(tmp_path / "run")
+    with RunJournal.open(out, {"names": ("a", "b")}) as j:
+        j.record("a", "ok")
+    # tuple vs list must not defeat resume (JSON normalizes both)
+    with RunJournal.open(out, {"names": ["a", "b"]}, resume=True) as j2:
+        assert j2.resumed
+    with open(os.path.join(out, "_manifest.json")) as f:
+        assert json.load(f)["config"] == {"names": ["a", "b"]}
+
+
+def test_atomic_write_publishes_complete_file(tmp_path):
+    p = tmp_path / "x.txt"
+    with atomic_write(str(p)) as f:
+        f.write("hello")
+        assert not p.exists()  # nothing visible until the replace
+    assert p.read_text() == "hello"
+    assert list(tmp_path.iterdir()) == [p]  # no temp residue
+
+
+def test_atomic_write_failure_keeps_previous_content(tmp_path):
+    p = tmp_path / "x.txt"
+    p.write_text("ORIGINAL")
+    with pytest.raises(RuntimeError):
+        with atomic_write(str(p)) as f:
+            f.write("partial garbage")
+            raise RuntimeError("crash mid-write")
+    assert p.read_text() == "ORIGINAL"
+    assert list(tmp_path.iterdir()) == [p]
+
+
+def test_atomic_write_rejects_append_modes(tmp_path):
+    with pytest.raises(ValueError):
+        with atomic_write(str(tmp_path / "x"), mode="at"):
+            pass

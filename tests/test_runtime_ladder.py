@@ -1,0 +1,176 @@
+"""Retry/degradation ladder units: OOM classification, chunk
+estimation, capacity escalation, backoff bounds, and the budgeted
+solver ladder exact -> lp -> greedy.
+
+The OOM escalation path (`_is_oom_error`, `_auto_chunk`,
+`escalate_capacities`) previously had no direct tests; these drive it
+through the fault-injection harness so the classifier is pinned
+against exactly the exception the harness (and XLA) raises.
+"""
+
+import numpy as np
+import pytest
+
+from repic_tpu.ops.solver import SolverBudgetExceeded, solve_exact
+from repic_tpu.pipeline.consensus import (
+    _auto_chunk,
+    _is_oom_error,
+    escalate_capacities,
+)
+from repic_tpu.runtime import faults
+from repic_tpu.runtime.ladder import (
+    RetryPolicy,
+    classify_error,
+    is_oom_error,
+    solve_host_ladder,
+)
+
+pytestmark = pytest.mark.faults
+
+
+# ---- error classification ------------------------------------------
+
+
+def test_is_oom_error_matches_injected_oom():
+    with faults.fault_plan("oom"):
+        with pytest.raises(RuntimeError) as ei:
+            faults.inject("oom", "chunk:x")
+    assert is_oom_error(ei.value)
+    assert _is_oom_error(ei.value)  # historical alias, same policy
+    assert classify_error(ei.value) == "oom"
+
+
+def test_is_oom_error_variants():
+    assert is_oom_error(RuntimeError("RESOURCE_EXHAUSTED: oom"))
+    assert is_oom_error(RuntimeError("Out of memory while trying"))
+    assert not is_oom_error(RuntimeError("shape mismatch"))
+    assert classify_error(OSError("disk gone")) == "io"
+    assert classify_error(ValueError("bad row")) == "error"
+
+
+# ---- retry policy ---------------------------------------------------
+
+
+def test_backoff_is_exponential_and_capped():
+    p = RetryPolicy(max_retries=5, backoff_base_s=0.1, backoff_cap_s=0.5)
+    assert p.backoff(1) == pytest.approx(0.1)
+    assert p.backoff(2) == pytest.approx(0.2)
+    assert p.backoff(3) == pytest.approx(0.4)
+    assert p.backoff(4) == 0.5  # capped
+    assert p.backoff(100) == 0.5
+
+
+# ---- _auto_chunk ----------------------------------------------------
+
+
+def test_auto_chunk_env_and_axis(monkeypatch):
+    monkeypatch.delenv("REPIC_CONSENSUS_CHUNK", raising=False)
+    # explicit override is clamped to the workload and the mesh axis
+    monkeypatch.setenv("REPIC_CONSENSUS_CHUNK", "3")
+    assert _auto_chunk(100, 3, 1024, 4) == 4  # rounded up to axis
+    monkeypatch.setenv("REPIC_CONSENSUS_CHUNK", "64")
+    assert _auto_chunk(10, 3, 1024, 4) == 12  # clamped to workload
+    monkeypatch.delenv("REPIC_CONSENSUS_CHUNK", raising=False)
+    # budget path: power of two, multiple of the axis, >= axis
+    c = _auto_chunk(1024, 5, 4096, 8)
+    assert c % 8 == 0 and c >= 8 and (c & (c - 1)) == 0
+
+
+# ---- escalate_capacities -------------------------------------------
+
+
+def test_escalation_no_retry_when_within_capacity():
+    d, cap, cc, pc, retry = escalate_capacities(
+        np.array([8, 100, 10, 0]), 16, 1024, 64, 1024, has_grid=True
+    )
+    assert not retry
+    assert (d, cap, cc, pc) == (16, 1024, 64, 1024)
+
+
+def test_escalation_jumps_to_observed_requirement():
+    # adjacency 33 > 16 -> next {2^k, 1.5*2^k} bucket above 33 is 48
+    d, cap, cc, pc, retry = escalate_capacities(
+        np.array([33, 5000, 10, 0]), 16, 1024, 64, 1024, has_grid=False
+    )
+    assert retry
+    assert d == 48
+    assert cap >= 5000
+    assert cc == 64  # cell capacity untouched off-grid
+    assert pc == 1024
+
+
+def test_escalation_cell_and_partial_are_independent():
+    d, cap, cc, pc, retry = escalate_capacities(
+        np.array([8, 100, 200, 3000]), 16, 1024, 64, 1024, has_grid=True
+    )
+    assert retry
+    assert (d, cap) == (16, 1024)  # untouched
+    assert cc >= 200 and pc >= 3000
+
+
+# ---- solver budget + ladder ----------------------------------------
+
+
+def _instance():
+    """4 cliques on a shared-vertex chain; optimum picks 0 and 2."""
+    mv = np.array([[0, 1], [1, 2], [2, 3], [3, 4]], np.int64)
+    w = np.array([2.0, 1.5, 1.0, 0.4])
+    return mv, w, 5
+
+
+def test_solve_exact_budget_zero_raises():
+    mv, w, _ = _instance()
+    with pytest.raises(SolverBudgetExceeded):
+        solve_exact(mv, w, budget_s=-1.0)
+
+
+def test_solve_exact_node_budget_raises():
+    from repic_tpu.ops.solver import solve_exact_py
+
+    mv, w, _ = _instance()
+    with pytest.raises(SolverBudgetExceeded):
+        solve_exact_py(mv, w, node_limit=1, raise_on_limit=True)
+    # default behavior keeps the silent greedy fallback
+    picked = solve_exact_py(mv, w, node_limit=1)
+    assert picked.dtype == bool
+
+
+def test_ladder_exact_rung_is_optimal():
+    mv, w, nv = _instance()
+    picked, used = solve_host_ladder(mv, w, nv, solver="exact")
+    assert used == "exact"
+    assert list(np.where(picked)[0]) == [0, 2]
+
+
+def test_ladder_degrades_exact_to_lp_on_injection():
+    mv, w, nv = _instance()
+    with faults.fault_plan("solver_budget:exact:inf"):
+        picked, used = solve_host_ladder(mv, w, nv, solver="exact")
+    assert used == "lp"
+    assert picked.any()
+
+
+def test_ladder_degrades_to_greedy_when_exact_and_lp_exhausted():
+    mv, w, nv = _instance()
+    with faults.fault_plan(
+        "solver_budget:exact:inf", "solver_budget:lp:inf"
+    ):
+        picked, used = solve_host_ladder(mv, w, nv, solver="exact")
+    assert used == "greedy"
+    assert list(np.where(picked)[0]) == [0, 2]  # greedy is optimal here
+
+
+def test_ladder_real_time_budget_degrades():
+    mv, w, nv = _instance()
+    picked, used = solve_host_ladder(
+        mv, w, nv, solver="exact", budget_s=-1.0
+    )
+    assert used == "lp"  # exact rung exceeded its (already-past) budget
+    assert picked.any()
+
+
+def test_ladder_empty_problem():
+    picked, used = solve_host_ladder(
+        np.zeros((0, 2), np.int64), np.zeros(0), 4, solver="exact"
+    )
+    assert picked.shape == (0,) and used == "exact"

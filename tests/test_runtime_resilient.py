@@ -1,0 +1,298 @@
+"""End-to-end fault-tolerant directory runs: quarantine, retry,
+per-micrograph fallback, journaled resume, strict fail-fast, and the
+budgeted solver degradation — the acceptance scenario of the
+fault-tolerant consensus runtime (docs/robustness.md).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from repic_tpu.pipeline.consensus import run_consensus_dir
+from repic_tpu.runtime import faults
+from repic_tpu.runtime.journal import read_journal
+from repic_tpu.runtime.ladder import RetryPolicy
+from repic_tpu.utils import box_io
+
+pytestmark = pytest.mark.faults
+
+FAST = RetryPolicy(max_retries=1, backoff_base_s=0.001,
+                   backoff_cap_s=0.002)
+
+
+def _make_dir(tmp_path, m=6, k=3, n=30, seed=0):
+    rng = np.random.default_rng(seed)
+    d = tmp_path / "picks"
+    for p in range(k):
+        (d / f"picker{p}").mkdir(parents=True)
+    for i in range(m):
+        base = rng.uniform(50, 950, size=(n, 2))
+        for p in range(k):
+            jit = rng.normal(0, 10, size=base.shape)
+            conf = rng.uniform(0.1, 1.0, size=n)
+            with open(d / f"picker{p}" / f"mic{i}.box", "wt") as f:
+                for (x, y), c in zip(base + jit, conf):
+                    f.write(f"{x:.2f}\t{y:.2f}\t64\t64\t{c:.4f}\n")
+    return str(d)
+
+
+def _corrupt(data, name="mic2", picker="picker0"):
+    path = os.path.join(data, picker, name + ".box")
+    with open(path, "wt") as f:
+        f.write("x y w h conf\nthis is not a number at all\n")
+    return path
+
+
+def _boxes(out):
+    return {
+        f: open(os.path.join(out, f)).read()
+        for f in sorted(os.listdir(out))
+        if f.endswith(".box")
+    }
+
+
+def test_lenient_run_quarantines_and_resumes(tmp_path, monkeypatch):
+    """The acceptance scenario: one corrupt BOX + one injected OOM.
+
+    Lenient mode completes, quarantines exactly the bad micrograph,
+    and a follow-up --resume run re-processes only the quarantined
+    entry — verified on the journal contents."""
+    monkeypatch.setenv("REPIC_CONSENSUS_CHUNK", "2")
+    data = _make_dir(tmp_path)
+    _corrupt(data, "mic2")
+    out = str(tmp_path / "out")
+
+    with faults.fault_plan("oom:chunk:1"):
+        stats = run_consensus_dir(
+            data, out, 64, use_mesh=False, retry_policy=FAST
+        )
+        assert faults.fired_log()  # the OOM really fired
+
+    # run completed; exactly the corrupt micrograph was quarantined
+    assert sorted(stats["quarantined"]) == ["mic2"]
+    info = stats["quarantined"]["mic2"]
+    assert info["type"] == "BoxParseError"
+    assert "mic2.box" in info["message"]  # actionable: names the file
+    assert "mic2" not in stats["particle_counts"]
+    assert not os.path.exists(os.path.join(out, "mic2.box"))
+    others = [f"mic{i}" for i in range(6) if i != 2]
+    assert sorted(stats["particle_counts"]) == sorted(others)
+
+    # journal: quarantine entry + a retried chunk from the OOM rung
+    latest = {
+        e["name"]: e for e in read_journal(out) if "name" in e
+    }
+    assert latest["mic2"]["status"] == "quarantined"
+    assert latest["mic2"]["error"]["path"].endswith("picker0/mic2.box")
+    assert any(
+        e["status"] == "retried" for e in latest.values()
+    ), "the injected OOM must surface as a retried outcome"
+    assert stats["journal"]["quarantined"] == 1
+
+    # fix the input, resume: ONLY the quarantined entry re-processes
+    with open(os.path.join(data, "picker0", "mic2.box"), "wt") as f:
+        f.write("100 100 64 64 0.9\n150 150 64 64 0.8\n")
+    before = len(read_journal(out))
+    stats2 = run_consensus_dir(
+        data, out, 64, use_mesh=False, resume=True, retry_policy=FAST
+    )
+    assert stats2["resumed"] == 5
+    assert sorted(stats2["particle_counts"]) == ["mic2"]
+    assert stats2["quarantined"] == {}
+    assert os.path.exists(os.path.join(out, "mic2.box"))
+    new_entries = read_journal(out)[before:]
+    assert [e["name"] for e in new_entries if "name" in e] == ["mic2"]
+    assert new_entries[-1]["status"] == "ok"
+
+
+def test_injected_corrupt_box_quarantines_then_resumes(tmp_path):
+    """Same acceptance scenario, driven purely by injection: the
+    corrupt BOX and the OOM both come from the fault plan, and the
+    single-shot injection means --resume heals the run without
+    touching the input."""
+    data = _make_dir(tmp_path, m=4)
+    out = str(tmp_path / "out")
+    with faults.fault_plan("corrupt_box:mic3", "oom:chunk:1"):
+        stats = run_consensus_dir(
+            data, out, 64, use_mesh=False, retry_policy=FAST
+        )
+    assert sorted(stats["quarantined"]) == ["mic3"]
+    assert sorted(stats["particle_counts"]) == ["mic0", "mic1", "mic2"]
+    stats2 = run_consensus_dir(
+        data, out, 64, use_mesh=False, resume=True
+    )
+    assert stats2["resumed"] == 3
+    assert sorted(stats2["particle_counts"]) == ["mic3"]
+    latest = {e["name"]: e for e in read_journal(out) if "name" in e}
+    assert latest["mic3"]["status"] == "ok"
+
+
+def test_strict_mode_fails_fast_on_corrupt_input(tmp_path):
+    data = _make_dir(tmp_path, m=3)
+    _corrupt(data, "mic1")
+    out = str(tmp_path / "out")
+    with pytest.raises(box_io.BoxParseError, match="mic1.box"):
+        run_consensus_dir(data, out, 64, use_mesh=False, strict=True)
+
+
+def test_strict_mode_fails_fast_on_persistent_oom(tmp_path, monkeypatch):
+    monkeypatch.setenv("REPIC_CONSENSUS_CHUNK", "1")
+    data = _make_dir(tmp_path, m=3)
+    out = str(tmp_path / "out")
+    with faults.fault_plan("oom:chunk:inf"):
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            run_consensus_dir(data, out, 64, use_mesh=False, strict=True)
+
+
+def test_per_micrograph_fallback_and_quarantine(tmp_path, monkeypatch):
+    """Chunk-level ladder exhausted -> isolate micrographs; the one
+    that still fails is quarantined, the rest complete (degraded)."""
+    monkeypatch.delenv("REPIC_CONSENSUS_CHUNK", raising=False)
+    data = _make_dir(tmp_path, m=4)
+    out = str(tmp_path / "out")
+    with faults.fault_plan("oom:chunk:inf", "oom:mic:mic1:inf"):
+        stats = run_consensus_dir(
+            data, out, 64, use_mesh=False, retry_policy=FAST
+        )
+    assert sorted(stats["quarantined"]) == ["mic1"]
+    assert stats["quarantined"]["mic1"]["kind"] == "oom"
+    assert sorted(stats["particle_counts"]) == ["mic0", "mic2", "mic3"]
+    latest = {e["name"]: e for e in read_journal(out) if "name" in e}
+    assert latest["mic1"]["status"] == "quarantined"
+    for nm in ("mic0", "mic2", "mic3"):
+        assert latest[nm]["status"] == "degraded"
+    events = [e["event"] for e in read_journal(out) if "event" in e]
+    assert "per_micrograph_fallback" in events
+
+
+def test_transient_error_retries_then_succeeds(tmp_path, monkeypatch):
+    """A transient (non-OOM) chunk failure is retried with backoff
+    and the affected micrographs are journaled as retried."""
+    monkeypatch.delenv("REPIC_CONSENSUS_CHUNK", raising=False)
+    data = _make_dir(tmp_path, m=3)
+    out = str(tmp_path / "out")
+    with faults.fault_plan("io:chunk:1"):
+        stats = run_consensus_dir(
+            data, out, 64, use_mesh=False, retry_policy=FAST
+        )
+    assert stats["quarantined"] == {}
+    assert len(stats["particle_counts"]) == 3
+    latest = {e["name"]: e for e in read_journal(out) if "name" in e}
+    assert all(e["status"] == "retried" for e in latest.values())
+
+
+def test_crash_then_resume_matches_fresh_run(tmp_path, monkeypatch):
+    """Kill a strict run mid-directory; resume completes it and the
+    combined outputs are byte-identical to an uninterrupted run."""
+    monkeypatch.setenv("REPIC_CONSENSUS_CHUNK", "1")
+    data = _make_dir(tmp_path, m=5)
+    out = str(tmp_path / "out")
+    with faults.fault_plan("oom:chunk:mic3:inf"):
+        with pytest.raises(RuntimeError):
+            run_consensus_dir(data, out, 64, use_mesh=False, strict=True)
+    done_before = set(_boxes(out))
+    assert done_before  # the crash landed mid-run, not before it
+    assert "mic3.box" not in done_before
+
+    stats = run_consensus_dir(
+        data, out, 64, use_mesh=False, resume=True, strict=True
+    )
+    assert stats["resumed"] == len(done_before)
+    out_fresh = str(tmp_path / "fresh")
+    monkeypatch.delenv("REPIC_CONSENSUS_CHUNK", raising=False)
+    run_consensus_dir(data, out_fresh, 64, use_mesh=False)
+    assert _boxes(out) == _boxes(out_fresh)
+
+
+def test_solver_budget_degradation_is_journaled(tmp_path):
+    """exact -> lp -> greedy, with the rung that actually ran
+    recorded per micrograph in the journal."""
+    data = _make_dir(tmp_path, m=2)
+
+    # no pressure: the exact rung runs and is recorded
+    out0 = str(tmp_path / "exact")
+    stats = run_consensus_dir(
+        data, out0, 64, use_mesh=False, solver="exact"
+    )
+    latest = {e["name"]: e for e in read_journal(out0) if "name" in e}
+    assert all(e["solver"] == "exact" for e in latest.values())
+    assert all(e["status"] == "ok" for e in latest.values())
+    assert len(stats["particle_counts"]) == 2
+
+    # injected exhaustion of the exact rung: degrade to LP-rounding
+    out1 = str(tmp_path / "lp")
+    with faults.fault_plan("solver_budget:exact:inf"):
+        run_consensus_dir(data, out1, 64, use_mesh=False, solver="exact")
+    latest = {e["name"]: e for e in read_journal(out1) if "name" in e}
+    assert all(e["solver"] == "lp" for e in latest.values())
+    assert all(e["status"] == "degraded" for e in latest.values())
+
+    # exact AND lp exhausted: the terminal greedy rung still lands
+    out2 = str(tmp_path / "greedy")
+    with faults.fault_plan(
+        "solver_budget:exact:inf", "solver_budget:lp:inf"
+    ):
+        run_consensus_dir(data, out2, 64, use_mesh=False, solver="exact")
+    latest = {e["name"]: e for e in read_journal(out2) if "name" in e}
+    assert all(e["solver"] == "greedy" for e in latest.values())
+
+    # a REAL (already-expired) wall-clock budget, no injection
+    out3 = str(tmp_path / "budget")
+    run_consensus_dir(
+        data, out3, 64, use_mesh=False, solver="exact",
+        solver_budget_s=-1.0,
+    )
+    latest = {e["name"]: e for e in read_journal(out3) if "name" in e}
+    assert all(e["solver"] == "lp" for e in latest.values())
+    assert all(e["status"] == "degraded" for e in latest.values())
+
+
+def test_exact_solver_plain_path_output_format(tmp_path):
+    """solver=exact writes reference-format BOX files and never
+    selects conflicting cliques."""
+    data = _make_dir(tmp_path, m=2, n=20)
+    out = str(tmp_path / "out")
+    stats = run_consensus_dir(data, out, 64, use_mesh=False,
+                              solver="exact")
+    for name, count in stats["particle_counts"].items():
+        bs = box_io.read_box(os.path.join(out, name + ".box"))
+        assert bs.n == count > 0
+
+
+def test_resume_config_mismatch_restarts_from_scratch(tmp_path):
+    """--resume against a DIFFERENT run's out_dir must not leave the
+    other run's outputs behind (fresh-run semantics, for real)."""
+    data = _make_dir(tmp_path, m=2)
+    out = str(tmp_path / "out")
+    run_consensus_dir(data, out, 64, use_mesh=False)
+    with open(os.path.join(out, "stale_extra.box"), "wt") as f:
+        f.write("999 999 64 64 1.0\n")  # pretend: older dataset's file
+    stats = run_consensus_dir(
+        data, out, 128, use_mesh=False, resume=True  # box_size differs
+    )
+    assert stats["resumed"] == 0
+    assert not os.path.exists(os.path.join(out, "stale_extra.box"))
+    assert len(stats["particle_counts"]) == 2
+
+
+def test_negative_retries_rejected():
+    with pytest.raises(ValueError, match="max_retries"):
+        RetryPolicy(max_retries=-1)
+
+
+def test_solver_budget_requires_exact(tmp_path):
+    data = _make_dir(tmp_path, m=1)
+    with pytest.raises(ValueError, match="solver='exact'"):
+        run_consensus_dir(
+            data, str(tmp_path / "o"), 64, use_mesh=False,
+            solver="lp", solver_budget_s=5.0,
+        )
+
+
+def test_outputs_are_atomic_no_temp_residue(tmp_path):
+    data = _make_dir(tmp_path, m=3)
+    out = str(tmp_path / "out")
+    run_consensus_dir(data, out, 64, use_mesh=False)
+    residue = [f for f in os.listdir(out) if ".tmp" in f]
+    assert residue == []
