@@ -212,15 +212,27 @@ def main(args) -> None:
         seed=args.seed,
         compute_dtype="bfloat16" if args.bf16 else "float32",
     )
-    result = fit(
-        train_data,
-        train_labels,
-        val_data,
-        val_labels,
-        config,
-        init_params=init_params,
-        arch=args.arch,
+    # Run telemetry scope next to the checkpoint: train_epoch events,
+    # steps/sec gauge, loss-fetch cadence (docs/observability.md).
+    import os
+
+    from repic_tpu import telemetry
+
+    run_tlm = telemetry.start_run(
+        os.path.dirname(os.path.abspath(args.model_out))
     )
+    try:
+        result = fit(
+            train_data,
+            train_labels,
+            val_data,
+            val_labels,
+            config,
+            init_params=init_params,
+            arch=args.arch,
+        )
+    finally:
+        telemetry.finish_run(run_tlm)
     save_checkpoint(
         args.model_out,
         result.params,

@@ -21,7 +21,11 @@ import time
 
 import numpy as np
 
+from repic_tpu.telemetry import events as tlm_events
+
 name = "pick"
+
+_log = tlm_events.get_logger("pick")
 
 
 def add_arguments(parser) -> None:
@@ -91,11 +95,12 @@ def main(args) -> None:
         )
     norm = meta.get("patch_norm", "reference")
     if args.mode == "fcn" and norm != "global":
-        print(
-            "warning: fcn mode assumes global patch normalization but "
+        # structured logger (stderr at warning level) — message text
+        # unchanged from the print it replaced, so greps still match
+        _log.warning(
+            "fcn mode assumes global patch normalization but "
             f"the checkpoint was trained with {norm!r}; scores will "
-            "be approximate",
-            file=sys.stderr,
+            "be approximate"
         )
 
     mrcs = sorted(glob.glob(os.path.join(args.mrc_dir, "*.mrc")))
@@ -103,40 +108,50 @@ def main(args) -> None:
         sys.exit(f"error: no .mrc files in {args.mrc_dir}")
     os.makedirs(args.out_dir, exist_ok=True)
 
-    for path in mrcs:
-        t0 = time.time()
-        raw = mrc.read_mrc(path).astype(np.float32)
-        if raw.ndim == 3:  # single-frame stack
-            raw = raw[0]
-        coords = pick_micrograph(
-            params,
-            raw,
-            int(particle_size),
-            mode=args.mode,
-            norm=norm,
-            arch=meta.get("arch", "deep"),
-            dtype="bfloat16" if args.bf16 else "float32",
-        )
-        coords = coords[coords[:, 2] >= args.threshold]
-        stem = os.path.splitext(os.path.basename(path))[0]
-        if args.format == "star":
-            _write_star(
-                os.path.join(args.out_dir, stem + ".star"), coords
+    # Run telemetry scope: standalone picks leave their event log +
+    # metric snapshots next to the coordinate files, like consensus
+    # runs do (docs/observability.md).
+    from repic_tpu import telemetry
+
+    run_tlm = telemetry.start_run(args.out_dir)
+    try:
+        for path in mrcs:
+            t0 = time.perf_counter()
+            stem = os.path.splitext(os.path.basename(path))[0]
+            with tlm_events.span("pick_micrograph", micrograph=stem):
+                raw = mrc.read_mrc(path).astype(np.float32)
+                if raw.ndim == 3:  # single-frame stack
+                    raw = raw[0]
+                coords = pick_micrograph(
+                    params,
+                    raw,
+                    int(particle_size),
+                    mode=args.mode,
+                    norm=norm,
+                    arch=meta.get("arch", "deep"),
+                    dtype="bfloat16" if args.bf16 else "float32",
+                )
+            coords = coords[coords[:, 2] >= args.threshold]
+            if args.format == "star":
+                _write_star(
+                    os.path.join(args.out_dir, stem + ".star"), coords
+                )
+            else:
+                # BOX rows are lower-left corners (center - size/2),
+                # matching the converter's center->corner shift
+                # (reference coord_converter.py:366-374).
+                write_box(
+                    os.path.join(args.out_dir, stem + ".box"),
+                    coords[:, :2] - particle_size / 2,
+                    coords[:, 2],
+                    int(particle_size),
+                )
+            _log.info(
+                f"{stem}: {len(coords)} particles "
+                f"({time.perf_counter() - t0:.1f}s)"
             )
-        else:
-            # BOX rows are lower-left corners (center - size/2),
-            # matching the converter's center->corner shift
-            # (reference coord_converter.py:366-374).
-            write_box(
-                os.path.join(args.out_dir, stem + ".box"),
-                coords[:, :2] - particle_size / 2,
-                coords[:, 2],
-                int(particle_size),
-            )
-        print(
-            f"{stem}: {len(coords)} particles "
-            f"({time.time() - t0:.1f}s)"
-        )
+    finally:
+        telemetry.finish_run(run_tlm)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,50 @@
+"""``report`` subcommand — one summary for a journaled run directory.
+
+New capability beyond the reference CLI (whose only observability is
+per-micrograph runtime TSVs): joins a run's ``_journal.jsonl``
+(per-micrograph outcomes, docs/robustness.md) with the telemetry
+event stream and metrics snapshot (docs/observability.md) into a
+single operator summary — per-stage latency percentiles,
+retry/quarantine/solver-rung tallies, recompile and transfer totals.
+
+Host-only: reads JSON/JSONL/TSV artifacts, never imports jax, so it
+runs in seconds on a login node against a finished (or in-flight)
+run directory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+name = "report"
+
+
+def add_arguments(parser) -> None:
+    parser.add_argument(
+        "run_dir",
+        help="a consensus output directory (must hold the run's "
+        "_journal.jsonl; _events.jsonl/_metrics.json enrich the "
+        "summary when telemetry was enabled)",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the machine-readable summary instead of text",
+    )
+
+
+def main(args) -> None:
+    from repic_tpu.telemetry.report import build_report, format_report
+
+    report = build_report(args.run_dir)
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        print(format_report(report))
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    add_arguments(parser)
+    main(parser.parse_args())

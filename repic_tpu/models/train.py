@@ -29,11 +29,30 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from repic_tpu import telemetry
 from repic_tpu.models.cnn import (
     PickerCNN,
     arch_kwargs,
     compute_dtype,
     fc_l2_penalty,
+)
+from repic_tpu.telemetry import events as tlm_events
+
+# Training telemetry (docs/observability.md): device throughput and
+# host-sync cadence.  Each loss/eval fetch is a host<->device round
+# trip — the counter makes an accidental per-step fetch regression
+# (RT004 territory) visible in the run report.
+_STEPS_PER_SEC = telemetry.gauge(
+    "repic_train_steps_per_sec",
+    "training steps per wall-clock second, updated per epoch",
+)
+_LOSS_FETCHES = telemetry.counter(
+    "repic_train_loss_fetches_total",
+    "host fetches of the training loss (once per epoch by design)",
+)
+_EVAL_FETCHES = telemetry.counter(
+    "repic_train_eval_fetches_total",
+    "host fetches of accumulated validation miss counts",
 )
 
 
@@ -128,6 +147,8 @@ def evaluate(logits_fn, params, data, labels, batch_size=1024):
             )
         )
     total_wrong = int(jnp.stack(wrong).sum())  # the ONE fetch
+    _EVAL_FETCHES.inc()
+    telemetry.record_transfer(8)
     return 100.0 * total_wrong / len(labels)
 
 
@@ -191,6 +212,7 @@ def fit(
     history = []
     t0 = time.time()
     epochs_run = 0
+    step_mark, t_mark = 0, t0  # steps/sec gauge anchors
 
     max_steps = int(config.max_epochs * train_size) // batch_size
     for step in range(max_steps):
@@ -208,19 +230,45 @@ def fit(
             train_err = error_rate(
                 np.asarray(logits), np.asarray(labels)
             )
+            # ONE loss fetch per epoch (the cadence the counter
+            # tracks); history and the progress line share it
+            loss_val = float(loss)
+            _LOSS_FETCHES.inc()
+            telemetry.record_transfer(4)
+            now = time.time()
+            steps_per_sec = (step - step_mark) / max(
+                now - t_mark, 1e-9
+            )
+            step_mark, t_mark = step, now
+            if step > 0:
+                _STEPS_PER_SEC.set(round(steps_per_sec, 3))
             history.append(
                 {
                     "epoch": epochs_run,
-                    "loss": float(loss),
+                    "loss": loss_val,
                     "train_error": train_err,
                     "val_error": val_err,
                     "lr": float(schedule(step)),
                 }
             )
+            tlm_events.event(
+                "train_epoch",
+                epoch=epochs_run,
+                loss=round(loss_val, 6),
+                train_error=round(train_err, 4),
+                val_error=round(val_err, 4),
+                # epoch 0 fires before any steps ran — a 0.0 sample
+                # would poison throughput averages, so omit it there
+                **(
+                    {"steps_per_sec": round(steps_per_sec, 3)}
+                    if step > 0
+                    else {}
+                ),
+            )
             if config.verbose and epochs_run % config.log_every == 0:
                 dt = time.time() - t0
                 print(
-                    f"epoch {epochs_run}: loss {float(loss):.4f} "
+                    f"epoch {epochs_run}: loss {loss_val:.4f} "
                     f"train_err {train_err:.2f}% val_err {val_err:.2f}% "
                     f"({dt:.1f}s)"
                 )

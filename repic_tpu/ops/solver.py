@@ -30,6 +30,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from repic_tpu import telemetry
+
+# Budget telemetry (docs/observability.md): every budget exhaustion
+# is a degradation the runtime ladder will absorb — operators watch
+# these to see HOW OFTEN the exact solver actually holds its rung.
+_BUDGET_EXCEEDED = telemetry.counter(
+    "repic_solver_budget_exceeded_total",
+    "exact-solve budget exhaustions (kind=wall|nodes)",
+)
+_NODE_LIMIT_FALLBACKS = telemetry.counter(
+    "repic_solver_node_limit_fallbacks_total",
+    "silent per-component greedy fallbacks after a node-limit hit",
+)
+
 
 class SolverBudgetExceeded(RuntimeError):
     """An exact solve ran out of its wall-clock or node budget.
@@ -289,6 +303,7 @@ def solve_exact_py(
 
     for cid in range(n_comp):
         if deadline is not None and _time.monotonic() > deadline:
+            _BUDGET_EXCEEDED.inc(kind="wall")
             raise SolverBudgetExceeded(
                 "exact solve exceeded its wall-clock budget "
                 f"({cid}/{n_comp} components searched)"
@@ -320,6 +335,7 @@ def solve_exact_py(
             nodes_visited += 1
             if nodes_visited > node_limit:
                 if raise_on_limit:
+                    _BUDGET_EXCEEDED.inc(kind="nodes")
                     raise SolverBudgetExceeded(
                         f"exact solve exceeded its node budget "
                         f"({node_limit} nodes)"
@@ -331,6 +347,7 @@ def solve_exact_py(
                 and nodes_visited % 64 == 0
                 and _time.monotonic() > deadline
             ):
+                _BUDGET_EXCEEDED.inc(kind="wall")
                 raise SolverBudgetExceeded(
                     "exact solve exceeded its wall-clock budget "
                     f"(component {cid}, {nodes_visited} nodes)"
@@ -355,6 +372,7 @@ def solve_exact_py(
                 )
             )
         if aborted:
+            _NODE_LIMIT_FALLBACKS.inc()
             # Greedy fallback (never expected on real data).
             blocked_set: set[int] = set()
             best_sel = []

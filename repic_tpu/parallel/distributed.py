@@ -28,6 +28,38 @@ from __future__ import annotations
 import os
 
 
+def _publish_host_gauges() -> None:
+    """Per-host identity gauges for the metrics registry.
+
+    A multi-host run writes one metrics snapshot per process
+    (telemetry sinks are per-host files); these gauges are what lets
+    a fleet-side aggregator attribute each snapshot to its host —
+    the arXiv:2112.09017 model of per-device telemetry rolled up
+    across a pod.  Called only on multi-process paths: the gauges
+    read ``jax.process_*``, which initializes the XLA backend, and
+    the single-process early-return must stay backend-free.
+    """
+    try:
+        import jax
+
+        from repic_tpu import telemetry
+
+        telemetry.gauge(
+            "repic_host_process_id",
+            "jax.process_index() of this host",
+        ).set(jax.process_index())
+        telemetry.gauge(
+            "repic_host_process_count",
+            "total processes in the distributed runtime",
+        ).set(jax.process_count())
+        telemetry.gauge(
+            "repic_host_local_device_count",
+            "devices addressable from this host",
+        ).set(jax.local_device_count())
+    except Exception:  # pragma: no cover - telemetry is best-effort
+        pass
+
+
 def initialize(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -54,6 +86,7 @@ def initialize(
         from jax._src import distributed as _jax_distributed
 
         if getattr(_jax_distributed.global_state, "client", None) is not None:
+            _publish_host_gauges()
             return jax.process_count() > 1  # safe: runtime already up
     except (ImportError, AttributeError) as e:
         # private-module layout changed; fall through to an explicit
@@ -101,8 +134,10 @@ def initialize(
                 RuntimeWarning,
                 stacklevel=2,
             )
+            _publish_host_gauges()
             return True
         raise
+    _publish_host_gauges()
     return True
 
 

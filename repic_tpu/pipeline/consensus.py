@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from repic_tpu import telemetry
 from repic_tpu.ops.cliques import (
     DEFAULT_THRESHOLD,
     compact_cliques,
@@ -49,7 +50,32 @@ from repic_tpu.runtime.ladder import (
     is_oom_error,
     solve_host_ladder,
 )
+from repic_tpu.telemetry import events as tlm_events
 from repic_tpu.utils import box_io
+
+_log = tlm_events.get_logger("consensus")
+
+# Telemetry instruments (docs/observability.md).  Capacity escalations
+# and chunk halvings are THE recompile-cost signals of this pipeline:
+# each escalation is a fresh XLA compile, each halving abandons a
+# compiled chunk shape.
+_ESCALATIONS = telemetry.counter(
+    "repic_consensus_capacity_escalations_total",
+    "batch re-runs forced by capacity-probe overflow "
+    "(each costs one fresh XLA compile)",
+)
+_CHUNK_HALVINGS = telemetry.counter(
+    "repic_consensus_chunk_halvings_total",
+    "OOM-driven micrograph-chunk halvings",
+)
+_CHUNKS = telemetry.counter(
+    "repic_consensus_chunks_total",
+    "consensus chunk executions",
+)
+_MICROGRAPHS = telemetry.counter(
+    "repic_consensus_micrographs_total",
+    "micrographs processed by directory-scale consensus runs",
+)
 
 
 class ConsensusResult(NamedTuple):
@@ -653,10 +679,17 @@ def run_consensus_batch(
                     res.max_cell_count, res.max_partial,
                 )
             )
+            telemetry.record_transfer(probes.nbytes)
         d, cap, cell_cap, pcap, retry = escalate_capacities(
             probes, d, cap, cell_cap, pcap, has_grid=grid is not None
         )
         if retry:
+            _ESCALATIONS.inc()
+            tlm_events.event(
+                "capacity_escalated",
+                max_neighbors=d, clique_capacity=cap,
+                cell_capacity=cell_cap, partial_capacity=pcap,
+            )
             continue
         # This batch's exact requirement (the probes are true counts
         # once nothing overflows).  Components whose probe is
@@ -825,13 +858,15 @@ def _pack_box_outputs(
 
 def _pack_result(res: "ConsensusResult") -> np.ndarray:
     """Host-fetch the packed output+probe array for a batched result."""
-    return np.asarray(
+    packed = np.asarray(
         _pack_box_outputs(
             res.picked, res.rep_xy, res.confidence, res.rep_slot,
             res.num_cliques, res.max_adjacency, res.max_cell_count,
             res.max_partial,
         )
     )
+    telemetry.record_transfer(packed.nbytes)
+    return packed
 
 
 def _packed_probes(packed: np.ndarray) -> np.ndarray:
@@ -1262,139 +1297,265 @@ def run_consensus_dir(
         shutil.rmtree(out_dir)
         os.makedirs(out_dir, exist_ok=True)
         journal = RunJournal.open(out_dir, run_config)
-    out_ext = ".tsv" if multi_out else ".box"
-    already_done = set()
-    if journal.resumed:
-        latest = journal.latest()  # one copy, not one per done name
-        for nm in journal.done_names():
-            out_name = latest[nm].get("out", nm + out_ext)
-            if os.path.exists(os.path.join(out_dir, out_name)):
-                already_done.add(nm)
-    todo_names = [n for n in names if n not in already_done]
+    # Telemetry run scope (docs/observability.md): the event log lives
+    # next to the journal; the metric sinks are written at each exit.
+    run_tlm = telemetry.start_run(out_dir)
+    try:
+        out_ext = ".tsv" if multi_out else ".box"
+        already_done = set()
+        if journal.resumed:
+            latest = journal.latest()  # one copy, not one per done name
+            for nm in journal.done_names():
+                out_name = latest[nm].get("out", nm + out_ext)
+                if os.path.exists(os.path.join(out_dir, out_name)):
+                    already_done.add(nm)
+        todo_names = [n for n in names if n not in already_done]
 
-    # Parallel host-side parse: at the 1024-micrograph scale
-    # (BASELINE configs[4]) the sequential loop is the bottleneck,
-    # not the device program.  pandas' C parser releases the GIL, so
-    # threads scale; order stays deterministic via executor.map.
-    from concurrent.futures import ThreadPoolExecutor
+        # Parallel host-side parse: at the 1024-micrograph scale
+        # (BASELINE configs[4]) the sequential loop is the bottleneck,
+        # not the device program.  pandas' C parser releases the GIL, so
+        # threads scale; order stays deterministic via executor.map.
+        from concurrent.futures import ThreadPoolExecutor
 
-    def _load_one(nm):
-        """Load one micrograph; in lenient mode a parse/read failure
-        becomes a quarantine record instead of killing the run."""
-        try:
-            return box_io.load_micrograph_set(in_dir, pickers, nm)
-        except (box_io.BoxParseError, OSError) as e:
-            if strict:
-                raise
-            return e
+        def _load_one(nm):
+            """Load one micrograph; in lenient mode a parse/read failure
+            becomes a quarantine record instead of killing the run."""
+            try:
+                return box_io.load_micrograph_set(in_dir, pickers, nm)
+            except (box_io.BoxParseError, OSError) as e:
+                if strict:
+                    raise
+                return e
 
-    workers = min(32, max(4, os.cpu_count() or 4))
-    if len(todo_names) > 1:
-        with ThreadPoolExecutor(max_workers=workers) as ex:
-            all_sets = list(ex.map(_load_one, todo_names))
-    else:
-        all_sets = [_load_one(nm) for nm in todo_names]
-    loaded, skipped, quarantined = [], [], {}
-    for name, sets in zip(todo_names, all_sets):
-        if isinstance(sets, BaseException):
-            info = error_info(
-                sets, path=getattr(sets, "path", None),
-                kind=classify_error(sets),
-            )
-            quarantined[name] = info
-            journal.record(
-                name, "quarantined", error=info, stage="load"
-            )
-        elif sets is None:
-            skipped.append(name)
-            box_io.write_empty_box(os.path.join(out_dir, name + ".box"))
-            journal.record(name, "skipped", out=name + ".box")
-        else:
-            loaded.append((name, sets))
-
-    stats = {
-        "pickers": pickers,
-        "micrographs": len(names),
-        "skipped": skipped,
-        "quarantined": quarantined,
-        "resumed": len(already_done),
-        "load_s": time.time() - t0,
-        "num_cliques": 0,
-        "particle_counts": {},
-    }
-    if not loaded:
-        stats["journal"] = journal.summary()
-        journal.close()
-        return stats
-
-    timer.stages.append(("load", time.time() - t0))
-    n_dev = len(jax.devices()) if use_mesh else 1
-
-    if stripes == "auto":
-        # Stripe only when it pays: fewer micrographs than devices
-        # (the batched axis would leave devices idle) AND dense fields
-        # (enumeration is the dominant cost worth splitting).  The
-        # table flags need the batched path, so auto never conflicts.
-        max_n = max(
-            (bs.n for _, sets in loaded for bs in sets), default=0
-        )
-        if (
-            not (multi_out or get_cc or host_solver)
-            and len(loaded) < n_dev
-            and max_n > SPATIAL_THRESHOLD
-        ):
-            stripes = n_dev
-            if use_pallas:
-                import warnings
-
-                warnings.warn(
-                    "--pallas applies to the batched dense path "
-                    "only; --stripes auto selected the striped path",
-                    stacklevel=2,
+        workers = min(32, max(4, os.cpu_count() or 4))
+        with tlm_events.span("load", micrographs=len(todo_names)):
+            if len(todo_names) > 1:
+                with ThreadPoolExecutor(max_workers=workers) as ex:
+                    all_sets = list(ex.map(_load_one, todo_names))
+            else:
+                all_sets = [_load_one(nm) for nm in todo_names]
+        loaded, skipped, quarantined = [], [], {}
+        for name, sets in zip(todo_names, all_sets):
+            if isinstance(sets, BaseException):
+                info = error_info(
+                    sets, path=getattr(sets, "path", None),
+                    kind=classify_error(sets),
                 )
-        else:
-            stripes = None
+                quarantined[name] = info
+                journal.record(
+                    name, "quarantined", error=info, stage="load"
+                )
+            elif sets is None:
+                skipped.append(name)
+                box_io.write_empty_box(os.path.join(out_dir, name + ".box"))
+                journal.record(name, "skipped", out=name + ".box")
+            else:
+                loaded.append((name, sets))
 
-    if stripes is not None:
-        from repic_tpu.pipeline.giant import run_consensus_giant
+        stats = {
+            "pickers": pickers,
+            "micrographs": len(names),
+            "skipped": skipped,
+            "quarantined": quarantined,
+            "resumed": len(already_done),
+            "load_s": time.time() - t0,
+            "num_cliques": 0,
+            "particle_counts": {},
+        }
+        if not loaded:
+            stats["journal"] = journal.summary()
+            journal.close()
+            return stats
 
+        timer.stages.append(("load", time.time() - t0))
+        n_dev = len(jax.devices()) if use_mesh else 1
+
+        if stripes == "auto":
+            # Stripe only when it pays: fewer micrographs than devices
+            # (the batched axis would leave devices idle) AND dense fields
+            # (enumeration is the dominant cost worth splitting).  The
+            # table flags need the batched path, so auto never conflicts.
+            max_n = max(
+                (bs.n for _, sets in loaded for bs in sets), default=0
+            )
+            if (
+                not (multi_out or get_cc or host_solver)
+                and len(loaded) < n_dev
+                and max_n > SPATIAL_THRESHOLD
+            ):
+                stripes = n_dev
+                if use_pallas:
+                    import warnings
+
+                    warnings.warn(
+                        "--pallas applies to the batched dense path "
+                        "only; --stripes auto selected the striped path",
+                        stacklevel=2,
+                    )
+            else:
+                stripes = None
+
+        if stripes is not None:
+            from repic_tpu.pipeline.giant import run_consensus_giant
+
+            compute_s = 0.0
+            write_s = 0.0
+            counts = {}
+            num_cliques = 0
+            actual_stripes = stripes
+            for name, sets in loaded:
+                t1 = time.time()
+                with tlm_events.span(
+                    "consensus_micrograph", micrograph=name, striped=True
+                ):
+                    giant = run_consensus_giant(
+                        sets,
+                        box_size,
+                        n_stripes=stripes,
+                        threshold=threshold,
+                        max_neighbors=max_neighbors,
+                        use_mesh=use_mesh,
+                        spatial=spatial,
+                        solver=solver,
+                    )
+                _MICROGRAPHS.inc()
+                compute_s += time.time() - t1
+                actual_stripes = giant["n_stripes"]
+                t2 = time.time()
+                sel = giant["picked"]
+                counts[name] = _write_box_file(
+                    os.path.join(out_dir, name + ".box"),
+                    giant["rep_xy"][sel],
+                    giant["confidence"][sel],
+                    giant["rep_slot"][sel],
+                    box_size,
+                    num_particles,
+                )
+                write_s += time.time() - t2
+                num_cliques += giant["num_cliques"]
+                journal.record(
+                    name, "ok",
+                    wall_s=round(time.time() - t1, 6),
+                    solver=solver, out=name + ".box",
+                    particles=counts[name],
+                )
+            timer.stages.append(("compute", compute_s))
+            timer.stages.append(("write", write_s))
+            timer.write_tsv(out_dir, "consensus_runtime.tsv")
+            stats.update(
+                compute_s=compute_s,
+                write_s=write_s,
+                total_s=time.time() - t0,
+                particle_counts=counts,
+                num_cliques=num_cliques,
+                stripes=actual_stripes,
+            )
+            stats["journal"] = journal.summary()
+            journal.close()
+            return stats
+
+        want_tables = multi_out or get_cc
+        cc_fn = None
+        if get_cc:
+            from repic_tpu.ops.components import connected_component_labels
+
+            # Same scalar-or-per-picker size argument the clique graph
+            # uses, so the CC filter judges the graph the cliques came
+            # from (a max-size approximation would add/drop edges on
+            # mixed-size ensembles).
+            cc_sizes = np.asarray(box_size, np.float32)
+            cc_arg = cc_sizes if cc_sizes.ndim else float(box_size)
+            cc_fn = jax.jit(
+                jax.vmap(
+                    lambda xy, mask: connected_component_labels(
+                        xy, mask, cc_arg, threshold=threshold
+                    )
+                )
+            )
         compute_s = 0.0
         write_s = 0.0
-        counts = {}
+        counts: dict = {}
         num_cliques = 0
-        actual_stripes = stripes
-        for name, sets in loaded:
-            t1 = time.time()
-            giant = run_consensus_giant(
-                sets,
-                box_size,
-                n_stripes=stripes,
-                threshold=threshold,
-                max_neighbors=max_neighbors,
-                use_mesh=use_mesh,
-                spatial=spatial,
-                solver=solver,
-            )
-            compute_s += time.time() - t1
-            actual_stripes = giant["n_stripes"]
+        parts = []
+        outcomes = ChunkOutcomes()
+        # The exact solver runs host-side on the fetched result, so it
+        # shares the tables data path; the device program keeps the cheap
+        # greedy pack (its picks are recomputed on the host ladder).
+        want_fetch = want_tables or host_solver
+        device_solver = "greedy" if host_solver else solver
+        for part, cbatch, res, extra, chunk_s in iter_consensus_chunks(
+            loaded,
+            box_size,
+            n_dev=n_dev,
+            threshold=threshold,
+            max_neighbors=max_neighbors,
+            use_mesh=use_mesh,
+            spatial=spatial,
+            solver=device_solver,
+            use_pallas=use_pallas,
+            extra_device_outputs=(
+                None
+                if cc_fn is None
+                else lambda b: cc_fn(jnp.asarray(b.xy), jnp.asarray(b.mask))
+            ),
+            fetch=want_fetch,
+            # plain BOX output: one packed transfer per chunk carries the
+            # escalation probes AND everything the writer needs
+            packed=not want_fetch,
+            strict=strict,
+            policy=policy,
+            outcomes=outcomes,
+            journal=journal,
+        ):
+            parts.append(len(part))
+            compute_s += chunk_s
+            if host_solver:
+                t_solve = time.time()
+                with tlm_events.span("host_solve", micrographs=len(part)):
+                    res = _host_solve_chunk(
+                        part, res, cbatch.capacity,
+                        budget_s=solver_budget_s,
+                        outcomes=outcomes,
+                        strict=strict,
+                    )
+                compute_s += time.time() - t_solve
             t2 = time.time()
-            sel = giant["picked"]
-            counts[name] = _write_box_file(
-                os.path.join(out_dir, name + ".box"),
-                giant["rep_xy"][sel],
-                giant["confidence"][sel],
-                giant["rep_slot"][sel],
-                box_size,
-                num_particles,
-            )
+            with tlm_events.span("write", micrographs=len(part)):
+                if want_fetch:
+                    counts.update(
+                        write_consensus_tables(
+                            part, res, extra, out_dir, box_size, pickers,
+                            multi_out=multi_out,
+                            get_cc=get_cc,
+                            num_particles=num_particles,
+                        )
+                    )
+                    num_cliques += int(
+                        np.sum(np.asarray(res.num_cliques))
+                    )
+                else:
+                    chunk_counts, chunk_nc = write_consensus_boxes(
+                        cbatch, res, out_dir, box_size,
+                        num_particles=num_particles,
+                        with_num_cliques=True,
+                        prefetched_packed=extra,  # zero extra transfers
+                    )
+                    counts.update(chunk_counts)
+                    num_cliques += int(chunk_nc.sum())
             write_s += time.time() - t2
-            num_cliques += giant["num_cliques"]
-            journal.record(
-                name, "ok",
-                wall_s=round(time.time() - t1, 6),
-                solver=solver, out=name + ".box",
-                particles=counts[name],
-            )
+            _MICROGRAPHS.inc(len(part))
+            for nm, _sets in part:
+                journal.record(
+                    nm,
+                    outcomes.status.get(nm, "ok"),
+                    wall_s=round(chunk_s / max(len(part), 1), 6),
+                    solver=outcomes.solver.get(nm, solver),
+                    particles=counts.get(nm),
+                    out=nm + out_ext,
+                )
+        # ladder-exhausted micrographs quarantined during chunking (the
+        # iterator already journaled them as they happened)
+        quarantined.update(outcomes.quarantined)
         timer.stages.append(("compute", compute_s))
         timer.stages.append(("write", write_s))
         timer.write_tsv(out_dir, "consensus_runtime.tsv")
@@ -1404,125 +1565,17 @@ def run_consensus_dir(
             total_s=time.time() - t0,
             particle_counts=counts,
             num_cliques=num_cliques,
-            stripes=actual_stripes,
         )
         stats["journal"] = journal.summary()
         journal.close()
+        if len(parts) > 1:
+            stats["chunk"] = max(parts)
         return stats
-
-    want_tables = multi_out or get_cc
-    cc_fn = None
-    if get_cc:
-        from repic_tpu.ops.components import connected_component_labels
-
-        # Same scalar-or-per-picker size argument the clique graph
-        # uses, so the CC filter judges the graph the cliques came
-        # from (a max-size approximation would add/drop edges on
-        # mixed-size ensembles).
-        cc_sizes = np.asarray(box_size, np.float32)
-        cc_arg = cc_sizes if cc_sizes.ndim else float(box_size)
-        cc_fn = jax.jit(
-            jax.vmap(
-                lambda xy, mask: connected_component_labels(
-                    xy, mask, cc_arg, threshold=threshold
-                )
-            )
-        )
-    compute_s = 0.0
-    write_s = 0.0
-    counts: dict = {}
-    num_cliques = 0
-    parts = []
-    outcomes = ChunkOutcomes()
-    # The exact solver runs host-side on the fetched result, so it
-    # shares the tables data path; the device program keeps the cheap
-    # greedy pack (its picks are recomputed on the host ladder).
-    want_fetch = want_tables or host_solver
-    device_solver = "greedy" if host_solver else solver
-    for part, cbatch, res, extra, chunk_s in iter_consensus_chunks(
-        loaded,
-        box_size,
-        n_dev=n_dev,
-        threshold=threshold,
-        max_neighbors=max_neighbors,
-        use_mesh=use_mesh,
-        spatial=spatial,
-        solver=device_solver,
-        use_pallas=use_pallas,
-        extra_device_outputs=(
-            None
-            if cc_fn is None
-            else lambda b: cc_fn(jnp.asarray(b.xy), jnp.asarray(b.mask))
-        ),
-        fetch=want_fetch,
-        # plain BOX output: one packed transfer per chunk carries the
-        # escalation probes AND everything the writer needs
-        packed=not want_fetch,
-        strict=strict,
-        policy=policy,
-        outcomes=outcomes,
-        journal=journal,
-    ):
-        parts.append(len(part))
-        compute_s += chunk_s
-        if host_solver:
-            t_solve = time.time()
-            res = _host_solve_chunk(
-                part, res, cbatch.capacity,
-                budget_s=solver_budget_s,
-                outcomes=outcomes,
-                strict=strict,
-            )
-            compute_s += time.time() - t_solve
-        t2 = time.time()
-        if want_fetch:
-            counts.update(
-                write_consensus_tables(
-                    part, res, extra, out_dir, box_size, pickers,
-                    multi_out=multi_out,
-                    get_cc=get_cc,
-                    num_particles=num_particles,
-                )
-            )
-            write_s += time.time() - t2
-            num_cliques += int(np.sum(np.asarray(res.num_cliques)))
-        else:
-            chunk_counts, chunk_nc = write_consensus_boxes(
-                cbatch, res, out_dir, box_size,
-                num_particles=num_particles,
-                with_num_cliques=True,
-                prefetched_packed=extra,  # zero further transfers
-            )
-            counts.update(chunk_counts)
-            write_s += time.time() - t2
-            num_cliques += int(chunk_nc.sum())
-        for nm, _sets in part:
-            journal.record(
-                nm,
-                outcomes.status.get(nm, "ok"),
-                wall_s=round(chunk_s / max(len(part), 1), 6),
-                solver=outcomes.solver.get(nm, solver),
-                particles=counts.get(nm),
-                out=nm + out_ext,
-            )
-    # ladder-exhausted micrographs quarantined during chunking (the
-    # iterator already journaled them as they happened)
-    quarantined.update(outcomes.quarantined)
-    timer.stages.append(("compute", compute_s))
-    timer.stages.append(("write", write_s))
-    timer.write_tsv(out_dir, "consensus_runtime.tsv")
-    stats.update(
-        compute_s=compute_s,
-        write_s=write_s,
-        total_s=time.time() - t0,
-        particle_counts=counts,
-        num_cliques=num_cliques,
-    )
-    stats["journal"] = journal.summary()
-    journal.close()
-    if len(parts) > 1:
-        stats["chunk"] = max(parts)
-    return stats
+    finally:
+        # exception-safe: a --strict raise must still restore
+        # the previous event log and write the metric sinks
+        # (idempotent after the normal-path call above)
+        telemetry.finish_run(run_tlm)
 
 
 def iter_consensus_chunks(
@@ -1629,11 +1682,19 @@ def iter_consensus_chunks(
                 # one packed transfer for the whole result (a tree
                 # device_get serializes ~10 round trips); extras (CC
                 # labels) remain a second fetch only when requested
-                res = _unpack_full_result(
-                    np.asarray(_pack_full_result(res)), k
-                )
+                full = np.asarray(_pack_full_result(res))
+                telemetry.record_transfer(full.nbytes)
+                res = _unpack_full_result(full, k)
                 if extras is not None:
                     extras = jax.device_get(extras)
+                    leaves = jax.tree_util.tree_leaves(extras)
+                    telemetry.record_transfer(
+                        sum(
+                            int(getattr(a, "nbytes", 0))
+                            for a in leaves
+                        ),
+                        fetches=len(leaves),
+                    )
             else:
                 jax.block_until_ready(res.picked)
             return res, extras
@@ -1646,14 +1707,18 @@ def iter_consensus_chunks(
             for attempt in range(policy.max_retries + 1):
                 t1 = time.time()
                 try:
-                    faults.inject("oom", mkey)
-                    faults.inject("io", mkey)
-                    b1 = pad_batch(
-                        [(name, sets)],
-                        pad_micrographs_to=1,
-                        capacity=nb,
-                    )
-                    res1, extras1 = _execute(b1, False)
+                    with tlm_events.span(
+                        "consensus_micrograph", micrograph=name,
+                        attempt=attempt,
+                    ):
+                        faults.inject("oom", mkey)
+                        faults.inject("io", mkey)
+                        b1 = pad_batch(
+                            [(name, sets)],
+                            pad_micrographs_to=1,
+                            capacity=nb,
+                        )
+                        res1, extras1 = _execute(b1, False)
                 except Exception as e:  # noqa: BLE001 — ladder rung
                     if attempt < policy.max_retries:
                         time.sleep(policy.backoff(attempt + 1))
@@ -1685,16 +1750,21 @@ def iter_consensus_chunks(
         ckey = f"chunk:{part[0][0]}:{len(part)}"
         t1 = time.time()
         try:
-            faults.inject("oom", ckey)
-            faults.inject("io", ckey)
-            res, extras = _execute(cbatch, use_mesh)
+            with tlm_events.span(
+                "consensus_chunk", micrographs=len(part)
+            ):
+                faults.inject("oom", ckey)
+                faults.inject("io", ckey)
+                res, extras = _execute(cbatch, use_mesh)
+            _CHUNKS.inc()
         except Exception as e:  # noqa: BLE001 — routed to the ladder
             kind = classify_error(e)
             if kind == "oom" and chunk > n_dev:
                 chunk = max(
                     -(-(chunk // 2) // n_dev) * n_dev, n_dev
                 )
-                print(
+                _CHUNK_HALVINGS.inc()
+                _log.info(
                     "consensus chunk exhausted device memory; "
                     f"retrying at {chunk} micrographs/chunk"
                 )

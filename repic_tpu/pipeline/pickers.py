@@ -31,6 +31,26 @@ import shutil
 import subprocess
 from dataclasses import dataclass, field
 
+from repic_tpu import telemetry
+from repic_tpu.telemetry import events as tlm_events
+
+# Per-host picker telemetry (docs/observability.md): in a multi-host
+# iterative run each process picks its own micrograph shard, so these
+# land in per-host metric snapshots and are aggregated fleet-side.
+_PICKED_PARTICLES = telemetry.counter(
+    "repic_picker_particles_total",
+    "particles written by picker adapters on this host",
+)
+_PICKED_MICROGRAPHS = telemetry.counter(
+    "repic_picker_micrographs_total",
+    "micrographs processed by picker adapters "
+    "(status=ok|empty|quarantined)",
+)
+_PICKER_LAST_TOTAL = telemetry.gauge(
+    "repic_picker_last_run_particles",
+    "particle count of the most recent predict() sweep per picker",
+)
+
 
 class PickerError(RuntimeError):
     pass
@@ -85,19 +105,23 @@ class BuiltinPicker:
             stem = os.path.splitext(os.path.basename(path))[0]
             out = os.path.join(out_box_dir, stem + ".box")
             try:
-                faults.inject("io", path)
-                raw = mrc_io.read_mrc(path).astype(np.float32)
-                if raw.ndim == 3:
-                    raw = raw[0]
-                coords = pick_micrograph(
-                    params,
-                    raw,
-                    self.particle_size,
-                    mode=self.mode,
-                    norm=meta.get("patch_norm", "reference"),
-                    arch=meta.get("arch", self.arch),
-                    dtype=self.compute_dtype,
-                )
+                with tlm_events.span(
+                    "pick_micrograph", picker=self.name,
+                    micrograph=stem,
+                ):
+                    faults.inject("io", path)
+                    raw = mrc_io.read_mrc(path).astype(np.float32)
+                    if raw.ndim == 3:
+                        raw = raw[0]
+                    coords = pick_micrograph(
+                        params,
+                        raw,
+                        self.particle_size,
+                        mode=self.mode,
+                        norm=meta.get("patch_norm", "reference"),
+                        arch=meta.get("arch", self.arch),
+                        dtype=self.compute_dtype,
+                    )
             except (OSError, ValueError) as e:
                 if not self.lenient:
                     # fail fast, but with the offending path attached
@@ -115,6 +139,9 @@ class BuiltinPicker:
                     RuntimeWarning,
                     stacklevel=2,
                 )
+                _PICKED_MICROGRAPHS.inc(
+                    picker=self.name, status="quarantined"
+                )
                 write_empty_box(out)
                 continue
             coords = coords[coords[:, 2] >= self.threshold]
@@ -129,7 +156,13 @@ class BuiltinPicker:
                     coords[:, 2],
                     self.particle_size,
                 )
+            _PICKED_MICROGRAPHS.inc(
+                picker=self.name,
+                status="ok" if len(coords) else "empty",
+            )
+            _PICKED_PARTICLES.inc(len(coords), picker=self.name)
             total += len(coords)
+        _PICKER_LAST_TOTAL.set(total, picker=self.name)
         return total
 
     def fit(
@@ -158,21 +191,22 @@ class BuiltinPicker:
             # each round retrains from the previous round's model
             # (reference run.sh:271, fit_deep.sh model_demo_type3)
             init_params, _ = load_checkpoint(self.model_path)
-        result = fit(
-            train_data,
-            train_labels,
-            val_data,
-            val_labels,
-            TrainConfig(
-                batch_size=self.batch_size,
-                max_epochs=self.max_epochs,
-                seed=self.seed,
-                verbose=False,
-                compute_dtype=self.compute_dtype,
-            ),
-            init_params=init_params,
-            arch=self.arch,
-        )
+        with tlm_events.span("picker_fit", picker=self.name):
+            result = fit(
+                train_data,
+                train_labels,
+                val_data,
+                val_labels,
+                TrainConfig(
+                    batch_size=self.batch_size,
+                    max_epochs=self.max_epochs,
+                    seed=self.seed,
+                    verbose=False,
+                    compute_dtype=self.compute_dtype,
+                ),
+                init_params=init_params,
+                arch=self.arch,
+            )
         save_checkpoint(
             model_out,
             result.params,

@@ -185,7 +185,12 @@ class RunJournal:
 
 
 def read_journal(out_dir: str) -> list[dict]:
-    """All journal entries of a finished run (test/inspection helper)."""
+    """All journal entries of a run (test/inspection/report helper).
+
+    Tolerates a torn trailing line the same way resume's
+    ``_load_entries`` does: a crash mid-append is exactly the run a
+    post-mortem ``repic-tpu report`` is pointed at.
+    """
     path = os.path.join(out_dir, JOURNAL_NAME)
     entries = []
     if not os.path.exists(path):
@@ -193,6 +198,10 @@ def read_journal(out_dir: str) -> list[dict]:
     with open(path) as f:
         for line in f:
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 entries.append(json.loads(line))
+            except ValueError:
+                continue  # torn trailing line from a crash
     return entries

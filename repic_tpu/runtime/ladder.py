@@ -141,6 +141,15 @@ def solve_host_ladder(
         solve_lp_rounding,
     )
 
+    # lazy (not module-level) so the runtime <-> telemetry import
+    # graph stays acyclic: telemetry's sinks import runtime.atomic
+    from repic_tpu.telemetry import metrics as _metrics
+
+    rung_total = _metrics.counter(
+        "repic_solver_rung_total",
+        "host solver ladder rungs that actually produced a packing",
+    )
+
     member_vertex = np.asarray(member_vertex)
     w = np.asarray(w)
     C = len(w)
@@ -152,24 +161,24 @@ def solve_host_ladder(
             continue  # injected budget exhaustion of this rung
         try:
             if rung == "exact":
-                return (
-                    solve_exact(
-                        member_vertex,
-                        w.astype(np.float64),
-                        node_limit=node_limit,
-                        budget_s=budget_s,
-                    ),
-                    rung,
+                picked = solve_exact(
+                    member_vertex,
+                    w.astype(np.float64),
+                    node_limit=node_limit,
+                    budget_s=budget_s,
                 )
-            picked = _solve_device(
-                solve_lp_rounding, member_vertex, w, num_vertices
-            )
-            return picked, rung
+            else:
+                picked = _solve_device(
+                    solve_lp_rounding, member_vertex, w, num_vertices
+                )
         except SolverBudgetExceeded:
             continue
+        rung_total.inc(rung=rung)
+        return picked, rung
     # terminal rung: greedy always terminates and takes no budget, so
     # the ladder cannot fail — there is no injection hook here.
     picked = _solve_device(solve_greedy, member_vertex, w, num_vertices)
+    rung_total.inc(rung=rungs[-1])
     return picked, rungs[-1]
 
 

@@ -1,0 +1,121 @@
+"""Telemetry subsystem: metrics, event spans, device probes, sinks.
+
+The observability layer every perf PR measures itself with
+(docs/observability.md).  Four parts:
+
+* :mod:`repic_tpu.telemetry.metrics` — process-wide registry of
+  counters / gauges / fixed-bucket histograms with label support;
+  near-zero overhead when disabled (``REPIC_TPU_TELEMETRY=0``).
+* :mod:`repic_tpu.telemetry.events` — structured JSONL event log
+  (run IDs, nested span IDs), plus the leveled structured logger that
+  replaced bare ``print`` in pipeline/commands.
+* :mod:`repic_tpu.telemetry.probes` — device telemetry sampled at
+  span boundaries: recompile count (``jax.monitoring``), transfer
+  bytes (instrumented fetch sites), live-buffer / device-memory
+  stats; every probe degrades to a no-op on CPU or absent APIs.
+* :mod:`repic_tpu.telemetry.sinks` — exporters: JSON snapshot,
+  Prometheus textfile, and the reference's ``*_runtime.tsv`` shape.
+
+``repic-tpu report <run_dir>`` (:mod:`repic_tpu.telemetry.report`)
+joins these artifacts with the PR 2 run journal into one summary.
+
+Run lifecycle (used by :func:`run_consensus_dir`)::
+
+    rt = telemetry.start_run(out_dir)     # _events.jsonl + probes
+    ... spans / counters fire ...
+    telemetry.finish_run(rt)              # _metrics.json / .prom
+"""
+
+from __future__ import annotations
+
+import os
+
+from repic_tpu.telemetry import events, metrics, probes, sinks
+from repic_tpu.telemetry.events import (  # noqa: F401
+    EVENTS_NAME,
+    event,
+    get_logger,
+    span,
+)
+from repic_tpu.telemetry.metrics import (  # noqa: F401
+    counter,
+    enabled,
+    gauge,
+    get_registry,
+    histogram,
+    set_enabled,
+)
+from repic_tpu.telemetry.probes import record_transfer  # noqa: F401
+from repic_tpu.telemetry.sinks import (  # noqa: F401
+    METRICS_JSON_NAME,
+    METRICS_PROM_NAME,
+)
+
+
+class RunTelemetry:
+    """Handle pairing :func:`start_run` with :func:`finish_run`."""
+
+    __slots__ = (
+        "out_dir", "log", "prev", "finished", "probes0", "registry0",
+    )
+
+    def __init__(self, out_dir, log, prev, probes0=None,
+                 registry0=None):
+        self.out_dir = out_dir
+        self.log = log
+        self.prev = prev
+        self.probes0 = probes0
+        self.registry0 = registry0
+        self.finished = False
+
+
+def start_run(out_dir: str, run_id: str | None = None) -> RunTelemetry:
+    """Open the per-run event log in ``out_dir`` and arm the probes.
+
+    Inert (no files, no listener) when telemetry is disabled — the
+    run then leaves only the journal behind and ``repic-tpu report``
+    degrades to journal-only tallies.  Probe counters and the
+    registry are baselined here so the run's sinks report THIS run's
+    numbers even when many runs share one process (iterative rounds).
+    """
+    if not metrics.enabled():
+        return RunTelemetry(out_dir, None, None)
+    probes.install()
+    log = events.EventLog(
+        os.path.join(out_dir, events.EVENTS_NAME), run_id=run_id
+    )
+    prev = events.set_current_log(log)
+    return RunTelemetry(
+        out_dir,
+        log,
+        prev,
+        probes0=probes.snapshot(sample_memory=False),
+        registry0=metrics.get_registry().as_dict(),
+    )
+
+
+def finish_run(rt: RunTelemetry | None) -> None:
+    """Publish probe deltas and write the metric sinks (idempotent).
+
+    Safe to call from a ``finally``: a run that raised still restores
+    the previous event log, closes the file, and writes the sinks
+    (its partial numbers are exactly what post-mortem triage wants).
+    """
+    if rt is None or rt.finished:
+        return
+    rt.finished = True
+    if rt.log is None:
+        return
+    events.set_current_log(rt.prev)
+    rt.log.close()
+    probes.publish(baseline=rt.probes0)
+    reg = metrics.get_registry()
+    per_run = metrics.diff_snapshots(reg.as_dict(), rt.registry0 or {})
+    sinks.write_metrics_json(
+        os.path.join(rt.out_dir, sinks.METRICS_JSON_NAME),
+        data=per_run,
+    )
+    sinks.write_prometheus_textfile(
+        os.path.join(rt.out_dir, sinks.METRICS_PROM_NAME),
+        data=per_run,
+    )

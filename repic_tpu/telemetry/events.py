@@ -1,0 +1,273 @@
+"""Structured JSONL event log: run IDs, nested spans, leveled logs.
+
+Replaces the two ad-hoc observability habits this port inherited from
+the reference — ``StageTimer`` tuples and bare ``print`` — with one
+structured stream:
+
+* **Spans** (:func:`span`) — named, attribute-carrying wall-clock
+  sections with process-unique IDs and parent links (nesting tracked
+  per thread via ``contextvars``).  Every span exit observes the
+  shared ``repic_span_seconds`` histogram, attaches the recompile /
+  transfer deltas that occurred inside it
+  (:mod:`repic_tpu.telemetry.probes`), and — when a run log is active
+  — appends one JSONL record.  ``StageTimer`` is now a thin shim over
+  these (:mod:`repic_tpu.utils.tracing`).
+* **Events** (:func:`event`) — point-in-time records (capacity
+  escalation, epoch summary) in the same stream.
+* **Leveled structured logger** (:func:`get_logger`) — replaces bare
+  ``print`` in pipeline/commands.  Messages keep their historical
+  text (grep-compatible) behind a level/logger prefix, and are
+  mirrored into the active run log as ``ev=log`` records.  Logging
+  stays live when telemetry is disabled — it replaces ``print``, so
+  silencing it would LOSE information the reference had.
+
+Record shapes (one JSON object per line, ``run`` = run ID)::
+
+    {"ev":"span","name":...,"span":7,"parent":3,"t":...,"dur_s":...}
+    {"ev":"event","name":...,"t":...}
+    {"ev":"log","level":"info","logger":...,"msg":...,"t":...}
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import itertools
+import json
+import os
+import sys
+import time
+import uuid
+
+from repic_tpu.telemetry import metrics, probes
+
+EVENTS_NAME = "_events.jsonl"
+
+# per-thread/ctx stack of open span ids (parent linkage)
+_SPAN_STACK: contextvars.ContextVar[tuple] = contextvars.ContextVar(
+    "repic_tpu_span_stack", default=()
+)
+_SPAN_IDS = itertools.count(1)
+_CURRENT_LOG: "EventLog | None" = None
+
+_SPAN_SECONDS = metrics.histogram(
+    "repic_span_seconds", "wall-clock duration of telemetry spans"
+)
+
+
+def new_run_id() -> str:
+    return uuid.uuid4().hex[:12]
+
+
+class EventLog:
+    """Append-only JSONL sink for one run (flushed per record)."""
+
+    def __init__(self, path: str, run_id: str | None = None):
+        self.path = path
+        self.run_id = run_id or new_run_id()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fh = open(path, "at")
+
+    def write(self, record: dict) -> None:
+        if self._fh is None:
+            return
+        record.setdefault("run", self.run_id)
+        self._fh.write(json.dumps(record, default=str) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def current_log() -> EventLog | None:
+    return _CURRENT_LOG
+
+
+def set_current_log(log: EventLog | None) -> EventLog | None:
+    """Install ``log`` as the process-wide run log; returns the
+    previous one (callers restore it, so sequential runs — e.g.
+    iterative rounds — nest correctly)."""
+    global _CURRENT_LOG
+    prev = _CURRENT_LOG
+    _CURRENT_LOG = log
+    return prev
+
+
+class _Span:
+    """Context manager measuring one named section.
+
+    Kept as a plain class (not ``@contextmanager``) so span entry is
+    two attribute writes + one ``perf_counter`` call — this sits
+    around per-chunk and per-micrograph hot paths.
+    """
+
+    __slots__ = (
+        "name", "attrs", "span_id", "parent_id",
+        "_t0", "_wall0", "_c0", "_token",
+    )
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        stack = _SPAN_STACK.get()
+        self.parent_id = stack[-1] if stack else None
+        self.span_id = next(_SPAN_IDS)
+        self._token = _SPAN_STACK.set(stack + (self.span_id,))
+        self._c0 = probes.counters()
+        self._wall0 = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        _SPAN_STACK.reset(self._token)
+        _SPAN_SECONDS.observe(dur, name=self.name)
+        log = _CURRENT_LOG
+        if log is not None:
+            rec = {
+                "ev": "span",
+                "name": self.name,
+                "span": self.span_id,
+                "t": round(self._wall0, 6),
+                "dur_s": round(dur, 6),
+            }
+            if self.parent_id is not None:
+                rec["parent"] = self.parent_id
+            c1 = probes.counters()
+            if c1[0] != self._c0[0]:
+                rec["recompiles"] = c1[0] - self._c0[0]
+            if c1[1] != self._c0[1]:
+                rec["transfer_bytes"] = c1[1] - self._c0[1]
+                rec["transfer_fetches"] = c1[2] - self._c0[2]
+            if exc_type is not None:
+                rec["error"] = exc_type.__name__
+            rec.update(self.attrs)
+            log.write(rec)
+        return False  # never swallow
+
+
+_NULL_SPAN = contextlib.nullcontext()
+
+
+def span(name: str, **attrs):
+    """A telemetry span; a shared no-op context when disabled."""
+    if not metrics.enabled():
+        return _NULL_SPAN
+    return _Span(name, attrs)
+
+
+def event(name: str, **fields) -> None:
+    """Point-in-time record into the active run log (no-op without
+    one; the metrics registry is the durable aggregate surface)."""
+    log = _CURRENT_LOG
+    if log is None or not metrics.enabled():
+        return
+    rec = {"ev": "event", "name": name, "t": round(time.time(), 6)}
+    stack = _SPAN_STACK.get()
+    if stack:
+        rec["span"] = stack[-1]
+    rec.update(fields)
+    log.write(rec)
+
+
+# -- leveled structured logger ---------------------------------------
+
+_LEVELS = {"debug": 10, "info": 20, "warning": 30, "error": 40}
+
+
+def _threshold() -> int:
+    name = os.environ.get("REPIC_TPU_LOG_LEVEL", "info").lower()
+    return _LEVELS.get(name, 20)
+
+
+class StructuredLogger:
+    """Leveled logger keeping historical message text greppable.
+
+    ``log.info("msg", key=value)`` prints
+    ``repic-tpu INFO [name] msg key=value`` — the message text itself
+    is unchanged from the ``print`` it replaced, so existing log
+    forensics (grep for "exhausted device memory", "particles") keep
+    matching — and mirrors the record into the active run log.
+    """
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def _log(self, level: str, msg: str, **fields) -> None:
+        if _LEVELS[level] < _threshold():
+            return
+        suffix = "".join(
+            f" {k}={v}" for k, v in fields.items()
+        )
+        stream = (
+            sys.stderr if _LEVELS[level] >= 30 else sys.stdout
+        )
+        print(
+            f"repic-tpu {level.upper()} [{self.name}] {msg}{suffix}",
+            file=stream,
+        )
+        log = _CURRENT_LOG
+        if log is not None and metrics.enabled():
+            rec = {
+                "ev": "log",
+                "level": level,
+                "logger": self.name,
+                "msg": msg,
+                "t": round(time.time(), 6),
+            }
+            rec.update(fields)
+            log.write(rec)
+
+    def debug(self, msg: str, **fields) -> None:
+        self._log("debug", msg, **fields)
+
+    def info(self, msg: str, **fields) -> None:
+        self._log("info", msg, **fields)
+
+    def warning(self, msg: str, **fields) -> None:
+        self._log("warning", msg, **fields)
+
+    def error(self, msg: str, **fields) -> None:
+        self._log("error", msg, **fields)
+
+
+_LOGGERS: dict[str, StructuredLogger] = {}
+
+
+def get_logger(name: str) -> StructuredLogger:
+    logger = _LOGGERS.get(name)
+    if logger is None:
+        logger = _LOGGERS[name] = StructuredLogger(name)
+    return logger
+
+
+def read_events(path_or_dir: str) -> list[dict]:
+    """All records of an event log (torn trailing lines skipped)."""
+    path = path_or_dir
+    if os.path.isdir(path):
+        path = os.path.join(path, EVENTS_NAME)
+    records = []
+    if not os.path.exists(path):
+        return records
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue  # torn trailing line from a crash
+    return records

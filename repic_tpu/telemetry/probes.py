@@ -1,0 +1,220 @@
+"""Device telemetry probes: recompiles, transfers, device memory.
+
+The signals that actually govern TPU throughput are invisible to
+wall-clock timers: a silent recompile (new input shape / new capacity
+config) costs minutes over a tunneled TPU, an extra host<->device
+fetch costs a full serialized round trip, and device-memory pressure
+is what the whole capacity-escalation machinery exists to manage.
+This module samples those signals so spans
+(:mod:`repic_tpu.telemetry.events`) can attach per-stage deltas and
+``repic-tpu report`` can print run totals.
+
+Three sources, each degrading gracefully to a no-op when the API is
+absent (CPU runs, older jax, no backend yet):
+
+* **Recompiles** — a ``jax.monitoring`` duration listener counting
+  ``/jax/core/compile/backend_compile_duration`` events (one per XLA
+  backend compile, cache misses only).  Falls back to 0 counts when
+  ``jax.monitoring`` is unavailable.
+* **Transfer bytes** — instrumented at this codebase's own fetch
+  sites (:func:`record_transfer`): the packed consensus transfers,
+  probe fetches, and the training loop's loss/eval fetches.  XLA has
+  no portable public transfer counter, so the framework counts the
+  transfers it performs; the count is a lower bound on bus traffic.
+* **Device memory / live buffers** — ``device.memory_stats()`` (None
+  on CPU) and ``jax.live_arrays()`` byte totals, sampled on demand
+  (snapshot time / top-level span exits), never per-operation.
+
+All counters are plain module ints bumped under the GIL — cheap
+enough to stay live even when telemetry is disabled (the listener is
+only installed by :func:`install`, which the run setup skips when
+disabled).
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_installed = False
+_install_failed = False
+
+# authoritative cumulative totals (module ints: listener + fetch
+# sites bump these; the registry mirrors them at publish() time)
+_compiles = 0
+_compile_seconds = 0.0
+_transfer_bytes = 0
+_transfer_fetches = 0
+
+
+def _on_event_duration(name: str, duration: float, **kw) -> None:
+    global _compiles, _compile_seconds
+    if name == "/jax/core/compile/backend_compile_duration":
+        with _lock:
+            _compiles += 1
+            _compile_seconds += float(duration)
+
+
+def install() -> bool:
+    """Register the recompile listener (idempotent, lazy jax import).
+
+    Returns True when the listener is active.  Failure (no jax, API
+    moved) is remembered so the import is not retried per call.
+    """
+    global _installed, _install_failed
+    if _installed:
+        return True
+    if _install_failed:
+        return False
+    try:
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration
+        )
+    except Exception:  # pragma: no cover - degraded environments
+        _install_failed = True
+        return False
+    _installed = True
+    return True
+
+
+def record_transfer(nbytes: int, fetches: int = 1) -> None:
+    """Count one (or more) host<->device transfers of ``nbytes``.
+
+    Called at this framework's fetch sites; a plain int add so the
+    hot paths pay nothing measurable.
+    """
+    global _transfer_bytes, _transfer_fetches
+    with _lock:
+        _transfer_bytes += int(nbytes)
+        _transfer_fetches += int(fetches)
+
+
+def counters() -> tuple[int, int, int]:
+    """(compiles, transfer_bytes, transfer_fetches) — the cheap
+    cumulative counters spans diff at their boundaries."""
+    return _compiles, _transfer_bytes, _transfer_fetches
+
+
+def device_memory() -> dict:
+    """Allocator stats of the first addressable device, or {}.
+
+    ``memory_stats()`` returns None on CPU and raises on exotic
+    backends; both degrade to an empty dict.
+    """
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return {}
+    if not stats:
+        return {}
+    out = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        if key in stats:
+            out[key] = int(stats[key])
+    return out
+
+
+def live_buffers() -> tuple[int, int]:
+    """(count, bytes) of live device arrays; (0, 0) when unavailable.
+
+    O(number of live arrays) — sampled at snapshot time and top-level
+    span exits only, never inside per-operation code.
+    """
+    try:
+        import jax
+
+        arrays = jax.live_arrays()
+        return len(arrays), sum(
+            int(getattr(a, "nbytes", 0)) for a in arrays
+        )
+    except Exception:
+        return 0, 0
+
+
+def snapshot(sample_memory: bool = True) -> dict:
+    """One JSON-safe sample of every probe (used by publish/report)."""
+    out = {
+        "recompiles": _compiles,
+        "compile_seconds": round(_compile_seconds, 6),
+        "transfer_bytes": _transfer_bytes,
+        "transfer_fetches": _transfer_fetches,
+    }
+    if sample_memory:
+        mem = device_memory()
+        if mem:
+            out["device_memory"] = mem
+        n, nbytes = live_buffers()
+        out["live_buffer_count"] = n
+        out["live_buffer_bytes"] = nbytes
+    return out
+
+
+def publish(registry=None, baseline: dict | None = None) -> dict:
+    """Mirror the probe totals into the metrics registry as gauges.
+
+    Returns the snapshot it published.  Gauges (not counters): the
+    module ints are the authoritative monotonic totals; the registry
+    copy is a point-in-time export for the sinks.  With ``baseline``
+    (an earlier :func:`snapshot`), the cumulative counters are
+    published as deltas — a run's sinks then report THAT run's
+    recompiles/transfers, not the process lifetime's (an iterative
+    pipeline runs many consensus rounds in one process).
+    """
+    from repic_tpu.telemetry import metrics as _metrics
+
+    reg = registry or _metrics.get_registry()
+    snap = snapshot()
+    if baseline:
+        for key in (
+            "recompiles",
+            "compile_seconds",
+            "transfer_bytes",
+            "transfer_fetches",
+        ):
+            snap[key] = snap[key] - baseline.get(key, 0)
+    reg.gauge(
+        "repic_recompiles_total",
+        "XLA backend compiles observed by jax.monitoring",
+    ).set(snap["recompiles"])
+    reg.gauge(
+        "repic_compile_seconds_total",
+        "cumulative XLA backend compile wall time",
+    ).set(snap["compile_seconds"])
+    reg.gauge(
+        "repic_transfer_bytes_total",
+        "host<->device bytes moved by instrumented fetch sites",
+    ).set(snap["transfer_bytes"])
+    reg.gauge(
+        "repic_transfer_fetches_total",
+        "host<->device round trips at instrumented fetch sites",
+    ).set(snap["transfer_fetches"])
+    reg.gauge(
+        "repic_live_buffer_count", "live device arrays at publish"
+    ).set(snap.get("live_buffer_count", 0))
+    reg.gauge(
+        "repic_live_buffer_bytes", "live device array bytes at publish"
+    ).set(snap.get("live_buffer_bytes", 0))
+    mem = snap.get("device_memory", {})
+    if mem:
+        g = reg.gauge(
+            "repic_device_memory_bytes",
+            "allocator stats of device 0 (absent on CPU)",
+        )
+        for key, val in mem.items():
+            g.set(val, stat=key)
+    return snap
+
+
+def reset_for_tests() -> None:
+    """Zero the cumulative counters (test isolation only)."""
+    global _compiles, _compile_seconds
+    global _transfer_bytes, _transfer_fetches
+    with _lock:
+        _compiles = 0
+        _compile_seconds = 0.0
+        _transfer_bytes = 0
+        _transfer_fetches = 0

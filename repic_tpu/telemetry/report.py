@@ -1,0 +1,283 @@
+"""Run-summary builder behind ``repic-tpu report <run_dir>``.
+
+Joins the three per-run artifacts a directory-scale consensus run
+leaves behind into one summary:
+
+* ``_journal.jsonl`` (PR 2 runtime) — per-micrograph outcomes, solver
+  rungs, wall times, ladder events;
+* ``_events.jsonl`` (telemetry) — spans (per-stage latencies with
+  recompile/transfer deltas), events, structured log records;
+* ``_metrics.json`` (telemetry) — the end-of-run registry snapshot
+  with the device-probe totals.
+
+Every section degrades independently: a journal-only run (telemetry
+disabled) still reports outcome tallies; an events-only directory
+still reports stage percentiles.  The joined summary is what a fleet
+operator pages on — per-stage p50/p95, retry/quarantine/rung tallies,
+recompile and transfer totals — per arXiv:2112.09017's model of
+per-device telemetry aggregated across a TPU fleet.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+from repic_tpu.telemetry import events as _events
+from repic_tpu.telemetry import sinks as _sinks
+
+
+def _percentile(values: list[float], q: float) -> float:
+    """Nearest-rank percentile (exact for the small-N span counts
+    a run produces; no interpolation surprises at N=1)."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    rank = max(int(math.ceil(q * len(ordered))) - 1, 0)
+    return float(ordered[min(rank, len(ordered) - 1)])
+
+
+def _stage_stats(durations: list[float]) -> dict:
+    return {
+        "count": len(durations),
+        "total_s": round(sum(durations), 6),
+        "mean_s": round(sum(durations) / len(durations), 6),
+        "p50_s": round(_percentile(durations, 0.50), 6),
+        "p95_s": round(_percentile(durations, 0.95), 6),
+        "max_s": round(max(durations), 6),
+    }
+
+
+def _gauge_value(metrics: dict, name: str):
+    entry = metrics.get(name)
+    if not entry:
+        return None
+    for sample in entry.get("samples", []):
+        if not sample.get("labels"):
+            return sample.get("value")
+    return None
+
+
+def _read_runtime_tsv(run_dir: str) -> dict:
+    """Legacy stage rows (summed per label), when present."""
+    path = os.path.join(run_dir, "consensus_runtime.tsv")
+    out: dict[str, float] = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                parts = line.rstrip("\n").split("\t")
+                if len(parts) != 2:
+                    continue
+                try:
+                    out[parts[0]] = out.get(parts[0], 0.0) + float(
+                        parts[1]
+                    )
+                except ValueError:
+                    continue
+    except OSError:
+        return {}
+    return out
+
+
+def build_report(run_dir: str) -> dict:
+    """Join journal + events + metrics of ``run_dir`` into one dict."""
+    from repic_tpu.runtime.journal import read_journal
+
+    if not os.path.isdir(run_dir):
+        raise FileNotFoundError(f"run directory not found: {run_dir}")
+
+    journal = read_journal(run_dir)
+    records = _events.read_events(run_dir)
+    metrics = _sinks.read_metrics_json(run_dir)
+
+    # -- journal: per-micrograph outcomes ----------------------------
+    latest: dict[str, dict] = {}
+    ladder = {
+        "chunk_retries": 0,
+        "chunk_halvings": 0,
+        "per_micrograph_fallbacks": 0,
+    }
+    for entry in journal:
+        if "name" in entry:
+            latest[entry["name"]] = entry
+        elif entry.get("event") == "chunk_retry":
+            ladder["chunk_retries"] += 1
+        elif entry.get("event") == "chunk_halved":
+            ladder["chunk_halvings"] += 1
+        elif entry.get("event") == "per_micrograph_fallback":
+            ladder["per_micrograph_fallbacks"] += 1
+
+    by_status: dict[str, int] = {}
+    solver_rungs: dict[str, int] = {}
+    wall, particles = [], 0
+    for e in latest.values():
+        s = e.get("status", "unknown")
+        by_status[s] = by_status.get(s, 0) + 1
+        if e.get("solver"):
+            solver_rungs[e["solver"]] = (
+                solver_rungs.get(e["solver"], 0) + 1
+            )
+        if isinstance(e.get("wall_s"), (int, float)):
+            wall.append(float(e["wall_s"]))
+        if isinstance(e.get("particles"), int):
+            particles += e["particles"]
+
+    # -- events: per-stage span latencies + probe deltas -------------
+    stage_durs: dict[str, list[float]] = {}
+    span_recompiles = 0
+    span_transfer_bytes = 0
+    span_transfer_fetches = 0
+    run_id = None
+    for rec in records:
+        run_id = rec.get("run", run_id)
+        if rec.get("ev") != "span":
+            continue
+        stage_durs.setdefault(rec.get("name", "?"), []).append(
+            float(rec.get("dur_s", 0.0))
+        )
+        span_recompiles += int(rec.get("recompiles", 0))
+        span_transfer_bytes += int(rec.get("transfer_bytes", 0))
+        span_transfer_fetches += int(rec.get("transfer_fetches", 0))
+
+    stages = {
+        name: _stage_stats(durs)
+        for name, durs in sorted(stage_durs.items())
+    }
+
+    # -- device probes: metrics snapshot, span deltas as fallback ----
+    recompiles = _gauge_value(metrics, "repic_recompiles_total")
+    transfer_bytes = _gauge_value(metrics, "repic_transfer_bytes_total")
+    transfer_fetches = _gauge_value(
+        metrics, "repic_transfer_fetches_total"
+    )
+    device = {
+        "recompiles": int(
+            recompiles if recompiles is not None else span_recompiles
+        ),
+        "transfer_bytes": int(
+            transfer_bytes
+            if transfer_bytes is not None
+            else span_transfer_bytes
+        ),
+        "transfer_fetches": int(
+            transfer_fetches
+            if transfer_fetches is not None
+            else span_transfer_fetches
+        ),
+    }
+    compile_s = _gauge_value(metrics, "repic_compile_seconds_total")
+    if compile_s is not None:
+        device["compile_seconds"] = round(float(compile_s), 3)
+
+    report = {
+        "run_dir": os.path.abspath(run_dir),
+        "run_id": run_id,
+        "micrographs": {
+            "total": len(latest),
+            "by_status": dict(sorted(by_status.items())),
+        },
+        "particles_total": particles,
+        "solver_rungs": dict(sorted(solver_rungs.items())),
+        "ladder": ladder,
+        "stages": stages,
+        "micrograph_wall_s": (
+            {
+                "count": len(wall),
+                "p50_s": round(_percentile(wall, 0.50), 6),
+                "p95_s": round(_percentile(wall, 0.95), 6),
+            }
+            if wall
+            else {}
+        ),
+        "device": device,
+        "runtime_tsv": _read_runtime_tsv(run_dir),
+    }
+    return report
+
+
+def _fmt_bytes(n: int) -> str:
+    size = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if size < 1024 or unit == "TiB":
+            return (
+                f"{int(size)} {unit}"
+                if unit == "B"
+                else f"{size:.1f} {unit}"
+            )
+        size /= 1024
+    return f"{n} B"
+
+
+def format_report(report: dict) -> str:
+    """Human-readable rendering of :func:`build_report` output."""
+    lines = [f"run: {report['run_dir']}"]
+    if report.get("run_id"):
+        lines.append(f"run id: {report['run_id']}")
+
+    mg = report["micrographs"]
+    tallies = ", ".join(
+        f"{k}={v}" for k, v in mg["by_status"].items()
+    ) or "none"
+    lines.append(f"micrographs: {mg['total']} ({tallies})")
+    lines.append(f"particles: {report['particles_total']}")
+
+    rungs = ", ".join(
+        f"{k}={v}" for k, v in report["solver_rungs"].items()
+    ) or "none recorded"
+    lines.append(f"solver rungs: {rungs}")
+
+    lad = report["ladder"]
+    lines.append(
+        "ladder: "
+        f"chunk_retries={lad['chunk_retries']} "
+        f"chunk_halvings={lad['chunk_halvings']} "
+        f"per_micrograph_fallbacks="
+        f"{lad['per_micrograph_fallbacks']} "
+        f"quarantined={mg['by_status'].get('quarantined', 0)}"
+    )
+
+    if report["stages"]:
+        lines.append("stage latencies (s):")
+        width = max(len(n) for n in report["stages"])
+        lines.append(
+            f"  {'stage'.ljust(width)}  count    p50      p95"
+            "      mean     total"
+        )
+        for name, st in report["stages"].items():
+            lines.append(
+                f"  {name.ljust(width)}  "
+                f"{st['count']:>5}  "
+                f"{st['p50_s']:>7.3f}  {st['p95_s']:>7.3f}  "
+                f"{st['mean_s']:>7.3f}  {st['total_s']:>8.3f}"
+            )
+    else:
+        lines.append(
+            "stage latencies: no event stream found "
+            "(telemetry disabled for this run?)"
+        )
+
+    mw = report.get("micrograph_wall_s")
+    if mw:
+        lines.append(
+            f"per-micrograph wall (journal): p50={mw['p50_s']:.3f}s "
+            f"p95={mw['p95_s']:.3f}s over {mw['count']}"
+        )
+
+    dev = report["device"]
+    dev_line = (
+        f"device: recompiles={dev['recompiles']} "
+        f"transfers={dev['transfer_fetches']} "
+        f"({_fmt_bytes(dev['transfer_bytes'])})"
+    )
+    if "compile_seconds" in dev:
+        dev_line += f" compile_time={dev['compile_seconds']:.1f}s"
+    lines.append(dev_line)
+
+    if report["runtime_tsv"]:
+        stages = " ".join(
+            f"{k}={v:.3f}s"
+            for k, v in report["runtime_tsv"].items()
+        )
+        lines.append(f"runtime.tsv: {stages}")
+    return "\n".join(lines)

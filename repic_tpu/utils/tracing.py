@@ -9,6 +9,12 @@ reference never had: real device profiling via ``jax.profiler``
 (XLA-level traces viewable in TensorBoard/Perfetto) and a structured
 stage timer.
 
+:class:`StageTimer` is now a thin shim over the telemetry span layer
+(:mod:`repic_tpu.telemetry.events`): each stage opens a real span
+(run-log record, ``repic_span_seconds`` histogram, probe deltas) and
+the timer keeps its historical ``(label, seconds)`` tuple surface for
+the legacy TSV writers.
+
 Usage::
 
     with trace_session("/tmp/prof"):          # device + host trace
@@ -53,31 +59,37 @@ class StageTimer:
 
     The TSV shape matches the reference's ``*_runtime.tsv`` habit
     (one row per stage, tab-separated) so downstream log-forensics
-    tooling keeps working.
+    tooling keeps working.  Durations use ``perf_counter`` (the
+    monotonic high-resolution clock — ``time.time()`` is wall clock
+    and jumps under NTP adjustment).
     """
 
     stages: list = field(default_factory=list)
 
     @contextlib.contextmanager
     def stage(self, label: str):
-        t0 = time.time()
+        from repic_tpu.telemetry import events
+
+        t0 = time.perf_counter()
         try:
-            yield
+            with events.span(label, kind="stage"):
+                yield
         finally:
-            self.stages.append((label, time.time() - t0))
+            self.stages.append((label, time.perf_counter() - t0))
 
     def as_dict(self) -> dict:
-        return {label: secs for label, secs in self.stages}
+        """Per-label total seconds.  Repeated stage labels AGGREGATE
+        (sum) — the previous dict comprehension silently kept only
+        the last occurrence of a repeated label."""
+        out: dict = {}
+        for label, secs in self.stages:
+            out[label] = out.get(label, 0.0) + secs
+        return out
 
     def write_tsv(self, out_dir: str, name: str = "runtime.tsv") -> str:
-        from repic_tpu.runtime.atomic import atomic_write
+        from repic_tpu.telemetry.sinks import write_runtime_tsv
 
-        os.makedirs(out_dir, exist_ok=True)
-        path = os.path.join(out_dir, name)
-        with atomic_write(path) as f:
-            for label, secs in self.stages:
-                f.write(f"{label}\t{secs:.6f}\n")
-        return path
+        return write_runtime_tsv(out_dir, self.stages, name=name)
 
 
 def annotate(label: str):

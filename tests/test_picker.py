@@ -251,5 +251,9 @@ def test_pick_cli(params, tmp_path, rng):
     cli_main(
         ["pick", ckpt, str(mrc_dir), str(out_dir), "--threshold", "0.0"]
     )
-    boxes = sorted(os.listdir(out_dir))
+    # telemetry sinks (_events.jsonl, _metrics.*) live next to the
+    # coordinate outputs now, like consensus run dirs
+    boxes = sorted(
+        f for f in os.listdir(out_dir) if f.endswith(".box")
+    )
     assert boxes == ["mic0.box", "mic1.box"]

@@ -1,0 +1,219 @@
+"""``repic-tpu report``: the journal + events + metrics join.
+
+The acceptance scenario of the telemetry subsystem
+(docs/observability.md): a journaled fixture consensus run must
+report per-stage latency percentiles, ladder-rung/retry/quarantine
+tallies, and recompile + transfer counters — and degrade to
+journal-only tallies when telemetry was disabled for the run.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from repic_tpu.main import main as cli_main
+from repic_tpu.pipeline.consensus import run_consensus_dir
+from repic_tpu.telemetry import metrics as tlm_metrics
+from repic_tpu.telemetry.report import build_report, format_report
+
+
+def _make_dir(tmp_path, m=6, k=3, n=30, seed=0):
+    rng = np.random.default_rng(seed)
+    d = tmp_path / "picks"
+    for p in range(k):
+        (d / f"picker{p}").mkdir(parents=True)
+    for i in range(m):
+        base = rng.uniform(50, 950, size=(n, 2))
+        for p in range(k):
+            jit = rng.normal(0, 10, size=base.shape)
+            conf = rng.uniform(0.1, 1.0, size=n)
+            with open(d / f"picker{p}" / f"mic{i}.box", "wt") as f:
+                for (x, y), c in zip(base + jit, conf):
+                    f.write(f"{x:.2f}\t{y:.2f}\t64\t64\t{c:.4f}\n")
+    return str(d)
+
+
+def _corrupt(data, name="mic2", picker="picker0"):
+    path = os.path.join(data, picker, name + ".box")
+    with open(path, "wt") as f:
+        f.write("x y w h conf\nthis is not a number at all\n")
+
+
+@pytest.fixture
+def journaled_run(tmp_path, monkeypatch):
+    """A lenient chunked exact-solver run with one quarantined
+    micrograph — journal, events, and metrics all populated."""
+    monkeypatch.setenv("REPIC_CONSENSUS_CHUNK", "2")
+    # n=70 buckets to a particle capacity no other test uses, so the
+    # run really compiles (recompiles >= 1) regardless of suite order
+    data = _make_dir(tmp_path, n=70)
+    _corrupt(data, "mic2")
+    out = str(tmp_path / "out")
+    stats = run_consensus_dir(
+        data, out, 64, use_mesh=False, solver="exact"
+    )
+    return out, stats
+
+
+def test_report_joins_all_artifacts(journaled_run):
+    out, stats = journaled_run
+    assert os.path.exists(os.path.join(out, "_events.jsonl"))
+    assert os.path.exists(os.path.join(out, "_metrics.json"))
+    assert os.path.exists(os.path.join(out, "_metrics.prom"))
+
+    report = build_report(out)
+    # outcome tallies from the journal
+    by_status = report["micrographs"]["by_status"]
+    assert by_status["quarantined"] == 1
+    assert by_status.get("ok", 0) + by_status.get("degraded", 0) == 5
+    assert report["micrographs"]["total"] == 6
+    # the exact host-solver rung recorded per micrograph
+    assert sum(report["solver_rungs"].values()) == 5
+    assert set(report["solver_rungs"]) <= {"exact", "lp", "greedy"}
+    # ladder tallies present even when zero
+    assert report["ladder"]["chunk_halvings"] == 0
+    # stage latency percentiles over the chunked spans (3 chunks)
+    chunk = report["stages"]["consensus_chunk"]
+    assert chunk["count"] == 3
+    assert 0 < chunk["p50_s"] <= chunk["p95_s"] <= chunk["max_s"]
+    for stage in ("load", "write", "host_solve"):
+        assert report["stages"][stage]["count"] >= 1
+    # device counters: CPU still compiles XLA programs, and the
+    # packed-fetch sites record their transfers
+    assert report["device"]["recompiles"] >= 1
+    assert report["device"]["transfer_bytes"] > 0
+    assert report["device"]["transfer_fetches"] >= 1
+    # legacy TSV joined too
+    assert set(report["runtime_tsv"]) >= {"load", "compute", "write"}
+
+
+def test_format_report_surfaces_the_acceptance_fields(journaled_run):
+    out, _ = journaled_run
+    text = format_report(build_report(out))
+    assert "p50" in text and "p95" in text
+    assert "quarantined=1" in text
+    assert "solver rungs:" in text
+    assert "recompiles=" in text
+    assert "transfers=" in text
+    assert "chunk_retries=" in text
+
+
+def test_report_cli_text_and_json(journaled_run, capsys):
+    out, _ = journaled_run
+    cli_main(["report", out])
+    text = capsys.readouterr().out
+    assert "stage latencies" in text
+    assert "micrographs: 6" in text
+
+    cli_main(["report", out, "--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert data["micrographs"]["by_status"]["quarantined"] == 1
+    assert data["stages"]["consensus_chunk"]["count"] == 3
+    assert data["device"]["transfer_bytes"] > 0
+
+
+def test_report_degrades_without_telemetry(tmp_path, monkeypatch):
+    """Telemetry disabled: the run leaves only the journal, no event
+    or metric files appear, and the report still tallies outcomes."""
+    data = _make_dir(tmp_path, m=3)
+    out = str(tmp_path / "out")
+    monkeypatch.setattr(tlm_metrics.REGISTRY, "_enabled", False)
+    run_consensus_dir(data, out, 64, use_mesh=False)
+    monkeypatch.setattr(tlm_metrics.REGISTRY, "_enabled", True)
+
+    assert not os.path.exists(os.path.join(out, "_events.jsonl"))
+    assert not os.path.exists(os.path.join(out, "_metrics.json"))
+    report = build_report(out)
+    assert report["micrographs"]["by_status"] == {"ok": 3}
+    assert report["stages"] == {}
+    assert "no event stream" in format_report(report)
+
+
+def test_report_missing_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        build_report(str(tmp_path / "nope"))
+
+
+def test_report_tolerates_torn_journal_line(journaled_run):
+    """A crash mid-append tears the last journal line; the post-
+    mortem report must summarize the run anyway."""
+    out, _ = journaled_run
+    with open(os.path.join(out, "_journal.jsonl"), "at") as f:
+        f.write('{"name": "mic9", "status": "o')
+    report = build_report(out)
+    assert report["micrographs"]["total"] == 6  # torn line skipped
+
+
+def test_strict_raise_still_finishes_telemetry(tmp_path):
+    """finish_run runs from the finally: a --strict failure restores
+    the previous event log and still writes the metric sinks."""
+    from repic_tpu.telemetry import events as tlm_events
+    from repic_tpu.utils.box_io import BoxParseError
+
+    data = _make_dir(tmp_path, m=3)
+    _corrupt(data, "mic1")
+    out = str(tmp_path / "out")
+    with pytest.raises(BoxParseError):
+        run_consensus_dir(data, out, 64, use_mesh=False, strict=True)
+    assert tlm_events.current_log() is None  # no leaked global log
+    assert os.path.exists(os.path.join(out, "_metrics.json"))
+    # a follow-up lenient run in the same process must write its own
+    # log, not append to the failed run's
+    size_failed = os.path.getsize(os.path.join(out, "_events.jsonl"))
+    run_consensus_dir(data, out + "2", 64, use_mesh=False)
+    assert (
+        os.path.getsize(os.path.join(out, "_events.jsonl"))
+        == size_failed
+    )
+    assert len(
+        {r["run"] for r in tlm_events.read_events(out + "2")}
+    ) == 1
+
+
+def test_metrics_snapshot_is_per_run(tmp_path):
+    """Two runs in one process: each run's _metrics.json reports its
+    OWN counters/probe totals, not the process-cumulative ones."""
+    from repic_tpu.telemetry import sinks as tlm_sinks
+
+    # unique particle count -> fresh padded shape -> run 1 really
+    # compiles (same-shape earlier tests would otherwise hit the
+    # in-process jit cache and legitimately report 0 recompiles)
+    data = _make_dir(tmp_path, m=3, n=37)
+    out1 = str(tmp_path / "r1")
+    out2 = str(tmp_path / "r2")
+    run_consensus_dir(data, out1, 64, use_mesh=False)
+    run_consensus_dir(data, out2, 64, use_mesh=False)
+
+    def micrographs_total(out):
+        m = tlm_sinks.read_metrics_json(out)
+        samples = m["repic_consensus_micrographs_total"]["samples"]
+        return sum(s["value"] for s in samples)
+
+    assert micrographs_total(out1) == 3
+    assert micrographs_total(out2) == 3  # not 6: per-run delta
+
+    # the identical second run reuses every compiled program, so its
+    # per-run recompile delta must be below the first run's total
+    r1 = build_report(out1)
+    r2 = build_report(out2)
+    assert r1["device"]["recompiles"] >= 1
+    assert r2["device"]["recompiles"] < r1["device"]["recompiles"]
+
+
+def test_events_stream_has_run_id_and_chunk_spans(journaled_run):
+    from repic_tpu.telemetry import events as tlm_events
+
+    out, _ = journaled_run
+    records = tlm_events.read_events(out)
+    assert records, "run should have produced event records"
+    run_ids = {r.get("run") for r in records}
+    assert len(run_ids) == 1
+    spans = [r for r in records if r.get("ev") == "span"]
+    names = {s["name"] for s in spans}
+    assert {"consensus_chunk", "load", "write"} <= names
+    # chunk spans carry their micrograph count (5 loaded at chunk
+    # size 2 -> chunks of 2, 2, 1)
+    chunk_spans = [s for s in spans if s["name"] == "consensus_chunk"]
+    assert sorted(s["micrographs"] for s in chunk_spans) == [1, 2, 2]

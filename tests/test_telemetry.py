@@ -1,0 +1,313 @@
+"""Telemetry package: registry semantics, spans, sinks, probes.
+
+Everything runs under JAX_PLATFORMS=cpu (conftest) — the probe layer
+must degrade gracefully there, which is itself under test.
+"""
+
+import json
+import time
+
+import pytest
+
+from repic_tpu.telemetry import events as tlm_events
+from repic_tpu.telemetry import probes, sinks
+from repic_tpu.telemetry.metrics import MetricsRegistry
+
+# ---------------------------------------------------------------- #
+# metrics registry                                                 #
+# ---------------------------------------------------------------- #
+
+
+def test_counter_inc_and_labels():
+    reg = MetricsRegistry(enabled=True)
+    c = reg.counter("c_total", "help text")
+    c.inc()
+    c.inc(2.5)
+    c.inc(rung="exact")
+    c.inc(3, rung="exact")
+    assert c.value() == 3.5
+    assert c.value(rung="exact") == 4.0
+    assert c.value(rung="lp") == 0.0
+
+
+def test_counter_rejects_decrease():
+    reg = MetricsRegistry(enabled=True)
+    with pytest.raises(ValueError):
+        reg.counter("c_total").inc(-1)
+
+
+def test_get_or_create_returns_same_handle():
+    reg = MetricsRegistry(enabled=True)
+    assert reg.counter("x_total") is reg.counter("x_total")
+
+
+def test_kind_conflict_raises():
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("x_total")
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")
+
+
+def test_gauge_set_add():
+    reg = MetricsRegistry(enabled=True)
+    g = reg.gauge("g")
+    g.set(4.0, host="a")
+    g.add(1.5, host="a")
+    g.set(7.0, host="b")
+    assert g.value(host="a") == 5.5
+    assert g.value(host="b") == 7.0
+
+
+def test_histogram_buckets_sum_count():
+    reg = MetricsRegistry(enabled=True)
+    h = reg.histogram("h_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    snap = h.snapshot()
+    # disjoint per-bucket counts: <=0.1, <=1, <=10, +Inf
+    assert snap["counts"] == [1, 2, 1, 1]
+    assert snap["count"] == 5
+    assert snap["sum"] == pytest.approx(56.05)
+
+
+def test_disabled_registry_is_noop():
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("c_total")
+    h = reg.histogram("h_seconds")
+    c.inc()
+    h.observe(1.0)
+    reg.gauge("g").set(5)
+    assert c.value() == 0.0
+    assert h.snapshot() is None
+    assert all(
+        not inst.samples() for inst in reg.instruments()
+    )
+
+
+def test_as_dict_shape():
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("c_total", "a counter").inc(2, k="v")
+    reg.histogram("h_seconds", buckets=(1.0,)).observe(0.5)
+    d = reg.as_dict()
+    assert d["c_total"]["kind"] == "counter"
+    assert d["c_total"]["samples"] == [
+        {"labels": {"k": "v"}, "value": 2.0}
+    ]
+    assert d["h_seconds"]["bucket_edges"] == [1.0]
+    assert d["h_seconds"]["samples"][0]["count"] == 1
+
+
+def test_disabled_mode_overhead_smoke():
+    """The disabled fast path must be branch-cheap: 20k no-op
+    increments + 20k no-op spans in well under a second (generous
+    bound — the point is catching an accidentally-hot disabled
+    path, not micro-benchmarking)."""
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter("c_total")
+    t0 = time.perf_counter()
+    for _ in range(20_000):
+        c.inc()
+    saved = tlm_events.metrics.REGISTRY._enabled
+    tlm_events.metrics.REGISTRY._enabled = False
+    try:
+        for _ in range(20_000):
+            with tlm_events.span("noop"):
+                pass
+    finally:
+        tlm_events.metrics.REGISTRY._enabled = saved
+    assert time.perf_counter() - t0 < 1.0
+
+
+# ---------------------------------------------------------------- #
+# events: spans, run log, logger                                   #
+# ---------------------------------------------------------------- #
+
+
+def _with_log(tmp_path, fn):
+    path = str(tmp_path / "events.jsonl")
+    log = tlm_events.EventLog(path)
+    prev = tlm_events.set_current_log(log)
+    try:
+        fn()
+    finally:
+        tlm_events.set_current_log(prev)
+        log.close()
+    return tlm_events.read_events(path), log.run_id
+
+
+def test_span_nesting_parent_ids(tmp_path):
+    def work():
+        with tlm_events.span("outer", micrographs=2):
+            with tlm_events.span("inner"):
+                pass
+            with tlm_events.span("inner"):
+                pass
+
+    records, run_id = _with_log(tmp_path, work)
+    spans = [r for r in records if r["ev"] == "span"]
+    # children close before the parent -> two inners then one outer
+    assert [s["name"] for s in spans] == ["inner", "inner", "outer"]
+    outer = spans[2]
+    assert outer["micrographs"] == 2
+    assert "parent" not in outer
+    assert all(s["parent"] == outer["span"] for s in spans[:2])
+    assert {s["run"] for s in spans} == {run_id}
+    assert len({s["span"] for s in spans}) == 3
+
+
+def test_span_records_error_and_reraises(tmp_path):
+    def work():
+        with pytest.raises(ValueError):
+            with tlm_events.span("fails"):
+                raise ValueError("boom")
+
+    records, _ = _with_log(tmp_path, work)
+    (span,) = [r for r in records if r["ev"] == "span"]
+    assert span["error"] == "ValueError"
+
+
+def test_event_and_logger_records(tmp_path, capsys):
+    def work():
+        tlm_events.event("capacity_escalated", cap=2048)
+        tlm_events.get_logger("consensus").info(
+            "chunk retried", attempt=2
+        )
+
+    records, _ = _with_log(tmp_path, work)
+    (ev,) = [r for r in records if r["ev"] == "event"]
+    assert ev["name"] == "capacity_escalated" and ev["cap"] == 2048
+    (lg,) = [r for r in records if r["ev"] == "log"]
+    assert lg["level"] == "info" and lg["attempt"] == 2
+    out = capsys.readouterr().out
+    # greppable: original message text intact behind the prefix
+    assert "chunk retried" in out
+    assert "repic-tpu INFO [consensus]" in out
+    assert "attempt=2" in out
+
+
+def test_logger_level_threshold(capsys, monkeypatch):
+    monkeypatch.setenv("REPIC_TPU_LOG_LEVEL", "warning")
+    log = tlm_events.get_logger("t")
+    log.info("hidden")
+    log.warning("shown")
+    captured = capsys.readouterr()
+    assert "hidden" not in captured.out + captured.err
+    assert "shown" in captured.err
+
+
+def test_spans_noop_without_run_log(tmp_path):
+    # no current log: spans still run the body, write nothing
+    with tlm_events.span("lonely"):
+        pass
+    assert tlm_events.read_events(str(tmp_path)) == []
+
+
+# ---------------------------------------------------------------- #
+# sinks                                                            #
+# ---------------------------------------------------------------- #
+
+
+def _sample_registry():
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("repic_c_total", "a counter").inc(3, kind="x")
+    reg.gauge("repic_g", "a gauge").set(1.5)
+    h = reg.histogram(
+        "repic_h_seconds", "a histogram", buckets=(0.1, 1.0)
+    )
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    return reg
+
+
+def test_metrics_json_roundtrip(tmp_path):
+    reg = _sample_registry()
+    path = str(tmp_path / "_metrics.json")
+    sinks.write_metrics_json(path, reg)
+    metrics = sinks.read_metrics_json(path)
+    assert metrics == reg.as_dict()
+    # directory form resolves the default name
+    assert sinks.read_metrics_json(str(tmp_path)) == metrics
+
+
+def test_prometheus_textfile(tmp_path):
+    reg = _sample_registry()
+    path = str(tmp_path / "_metrics.prom")
+    sinks.write_prometheus_textfile(path, reg)
+    text = open(path).read()
+    assert '# TYPE repic_c_total counter' in text
+    assert 'repic_c_total{kind="x"} 3' in text
+    assert "repic_g 1.5" in text
+    # cumulative buckets: 1, 2, then +Inf == count == 3
+    assert 'repic_h_seconds_bucket{le="0.1"} 1' in text
+    assert 'repic_h_seconds_bucket{le="1"} 2' in text
+    assert 'repic_h_seconds_bucket{le="+Inf"} 3' in text
+    assert "repic_h_seconds_count 3" in text
+
+
+def test_runtime_tsv_shape(tmp_path):
+    path = sinks.write_runtime_tsv(
+        str(tmp_path), [("load", 0.5), ("load", 0.25)]
+    )
+    assert open(path).read() == "load\t0.500000\nload\t0.250000\n"
+
+
+# ---------------------------------------------------------------- #
+# probes                                                           #
+# ---------------------------------------------------------------- #
+
+
+def test_record_transfer_accumulates():
+    c0 = probes.counters()
+    probes.record_transfer(1024)
+    probes.record_transfer(512, fetches=2)
+    c1 = probes.counters()
+    assert c1[1] - c0[1] == 1536
+    assert c1[2] - c0[2] == 3
+
+
+def test_recompile_listener_counts_fresh_compile():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    assert probes.install()
+    before = probes.counters()[0]
+    # unique embedded constant -> guaranteed fresh program (no jit or
+    # persistent-cache hit)
+    c = float(np.random.default_rng().uniform(1.0, 2.0))
+    jax.jit(lambda x: x * c)(jnp.ones(3)).block_until_ready()
+    assert probes.counters()[0] > before
+
+
+def test_snapshot_degrades_on_cpu():
+    snap = probes.snapshot()
+    assert snap["recompiles"] >= 0
+    assert snap["transfer_bytes"] >= 0
+    # CPU: memory_stats() is None -> key absent, live buffers fine
+    assert "live_buffer_count" in snap
+    assert isinstance(snap.get("device_memory", {}), dict)
+
+
+def test_publish_sets_gauges():
+    reg = MetricsRegistry(enabled=True)
+    snap = probes.publish(reg)
+    d = reg.as_dict()
+    assert (
+        d["repic_recompiles_total"]["samples"][0]["value"]
+        == snap["recompiles"]
+    )
+    assert (
+        d["repic_transfer_bytes_total"]["samples"][0]["value"]
+        == snap["transfer_bytes"]
+    )
+
+
+def test_event_log_skips_torn_lines(tmp_path):
+    path = tmp_path / "ev.jsonl"
+    path.write_text(
+        json.dumps({"ev": "event", "name": "a"})
+        + "\n{\"ev\": \"spa"
+    )
+    records = tlm_events.read_events(str(path))
+    assert [r["name"] for r in records] == ["a"]

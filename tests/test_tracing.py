@@ -1,0 +1,108 @@
+"""utils/tracing.py: StageTimer semantics (previously untested).
+
+Covers the two historical bugs fixed with the telemetry PR — wall
+clock instead of ``perf_counter``, and ``as_dict`` silently dropping
+repeated stage labels — plus the TSV surface and the no-op contracts
+of ``trace_session``/``annotate``.
+"""
+
+import os
+import time
+
+from repic_tpu.telemetry import events as tlm_events
+from repic_tpu.telemetry import metrics as tlm_metrics
+from repic_tpu.utils import tracing
+from repic_tpu.utils.tracing import StageTimer, annotate, trace_session
+
+
+def test_stage_records_positive_duration():
+    timer = StageTimer()
+    with timer.stage("work"):
+        time.sleep(0.005)
+    assert len(timer.stages) == 1
+    label, secs = timer.stages[0]
+    assert label == "work"
+    assert 0.004 <= secs < 5.0
+
+
+def test_stage_uses_perf_counter_not_wall_clock(monkeypatch):
+    """A wall-clock jump (NTP adjustment) must not corrupt stage
+    durations: with telemetry disabled the shim must never touch
+    ``time.time`` at all."""
+    monkeypatch.setattr(tlm_metrics.REGISTRY, "_enabled", False)
+
+    def boom():  # pragma: no cover - failing path
+        raise AssertionError("StageTimer used wall-clock time.time")
+
+    monkeypatch.setattr(time, "time", boom)
+    timer = StageTimer()
+    with timer.stage("work"):
+        pass
+    assert timer.stages[0][1] >= 0.0
+
+
+def test_as_dict_aggregates_repeated_labels():
+    """Repeated labels sum — the old dict comprehension kept only the
+    last occurrence (chunked runs emit 'compute' once per chunk)."""
+    timer = StageTimer()
+    timer.stages = [("compute", 1.0), ("write", 0.5), ("compute", 2.0)]
+    d = timer.as_dict()
+    assert d == {"compute": 3.0, "write": 0.5}
+
+
+def test_stage_records_on_exception():
+    timer = StageTimer()
+    try:
+        with timer.stage("fails"):
+            raise ValueError("boom")
+    except ValueError:
+        pass
+    assert [label for label, _ in timer.stages] == ["fails"]
+
+
+def test_write_tsv_keeps_reference_shape(tmp_path):
+    """One ``stage<TAB>seconds`` row per stage, duplicates preserved
+    as separate rows (the reference's appending-writer behavior)."""
+    timer = StageTimer()
+    timer.stages = [("load", 0.25), ("compute", 1.5), ("load", 0.75)]
+    path = timer.write_tsv(str(tmp_path))
+    rows = [
+        line.split("\t")
+        for line in open(path).read().splitlines()
+    ]
+    assert [r[0] for r in rows] == ["load", "compute", "load"]
+    assert [float(r[1]) for r in rows] == [0.25, 1.5, 0.75]
+    assert os.path.basename(path) == "runtime.tsv"
+
+
+def test_stage_emits_telemetry_span(tmp_path):
+    """StageTimer is a shim over the span layer: with a run log
+    active, each stage appends one span record."""
+    log = tlm_events.EventLog(str(tmp_path / "ev.jsonl"))
+    prev = tlm_events.set_current_log(log)
+    try:
+        timer = StageTimer()
+        with timer.stage("load"):
+            pass
+    finally:
+        tlm_events.set_current_log(prev)
+        log.close()
+    records = tlm_events.read_events(str(tmp_path / "ev.jsonl"))
+    spans = [r for r in records if r.get("ev") == "span"]
+    assert len(spans) == 1
+    assert spans[0]["name"] == "load"
+    assert spans[0]["kind"] == "stage"
+    assert spans[0]["dur_s"] >= 0.0
+
+
+def test_trace_session_none_is_noop(tmp_path):
+    ran = []
+    with trace_session(None):
+        ran.append(True)
+    assert ran == [True]
+
+
+def test_annotate_is_reentrant_context():
+    with annotate("outer"):
+        with annotate("inner"):
+            pass
