@@ -8,13 +8,29 @@ hazards — see :mod:`repic_tpu.analysis.rules` for the rule pack and
 docs/static_analysis.md for rationale, suppression syntax, and how to
 add a rule.
 
-Entry points: ``repic-tpu lint`` and ``python -m repic_tpu.analysis``.
-Programmatic use::
+A second, *semantic* layer rides the same package: accelerator entry
+points declare shape/dtype/sharding/donation contracts with
+``@repic_tpu.analysis.contracts.checked`` and ``repic-tpu check``
+(:mod:`repic_tpu.analysis.semantic`) verifies them at trace time via
+``jax.eval_shape`` — rules RT101/RT102/RT103/RT105.  The lint layer
+stays JAX-free; only ``check`` (and ``lint --deep``) imports JAX.
+
+Entry points: ``repic-tpu lint``, ``repic-tpu check`` and
+``python -m repic_tpu.analysis``.  Programmatic use::
 
     from repic_tpu.analysis import analyze_source, run_paths
     findings = run_paths(["repic_tpu"])
+
+    from repic_tpu.analysis.semantic import run_check
+    report = run_check(["repic_tpu"])   # imports JAX + targets
 """
 
+from repic_tpu.analysis.contracts import (
+    ArraySpec,
+    Contract,
+    checked,
+    spec,
+)
 from repic_tpu.analysis.engine import (
     Finding,
     analyze_source,
@@ -27,9 +43,13 @@ from repic_tpu.analysis.rules import ALL_RULES, RULES_BY_ID
 __all__ = [
     "ALL_RULES",
     "RULES_BY_ID",
+    "ArraySpec",
+    "Contract",
     "Finding",
     "analyze_source",
+    "checked",
     "format_report",
     "iter_python_files",
     "run_paths",
+    "spec",
 ]

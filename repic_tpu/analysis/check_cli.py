@@ -1,0 +1,99 @@
+"""``repic-tpu check`` — the trace-time semantic-analysis subcommand.
+
+Follows the repo's subcommand protocol (``name`` /
+``add_arguments(parser)`` / ``main(args)``, see
+:mod:`repic_tpu.main`).  Unlike ``lint`` this command DOES import JAX
+(and the target modules themselves): the whole point is to verify the
+traced program, not the source text.  Degraded environments (no JAX,
+a module that fails to import, hardware-dependent example builders)
+produce structured ``skip`` records and a zero exit — only contract
+findings fail the gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+name = "check"
+
+
+def add_arguments(parser: argparse.ArgumentParser) -> None:
+    parser.description = (
+        "Trace-time contract checker (rules RT101/RT102/RT103/RT105: "
+        "eval_shape shape/dtype contracts, PartitionSpec axis "
+        "consistency, donated-buffer use-after-donation, recompile "
+        "fingerprints).  Entry points register via "
+        "@repic_tpu.analysis.contracts.checked.  Exits non-zero on "
+        "findings; import failures are structured skips."
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=["repic_tpu"],
+        help="files or directories to check (default: repic_tpu)",
+    )
+    parser.add_argument(
+        "--select",
+        default=None,
+        metavar="RULES",
+        help="comma-separated RT1xx rule IDs to run (default: all)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=["text", "json"],
+        default="text",
+        help="report format (json: {findings, checked, skipped})",
+    )
+    parser.add_argument(
+        "--hints",
+        action="store_true",
+        help="append each rule's fix-hint to its findings",
+    )
+    parser.add_argument(
+        "--list-entries",
+        action="store_true",
+        help="import targets, print the registered entry points, exit",
+    )
+
+
+def main(args: argparse.Namespace) -> None:
+    from repic_tpu.analysis.semantic import SEMANTIC_RULES, run_check
+
+    select = None
+    if args.select:
+        select = {
+            s.strip().upper() for s in args.select.split(",") if s.strip()
+        }
+        unknown = select - set(SEMANTIC_RULES)
+        if unknown:
+            sys.exit(f"unknown rule id(s): {', '.join(sorted(unknown))}")
+    report = run_check(
+        args.paths, select=select, collect_only=args.list_entries
+    )
+    if args.format == "json":
+        json.dump(report.to_json(), sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        if args.list_entries:
+            for e in report.checked:
+                print(f"{e['entry']}  ({e['path']}:{e['line']})")
+        for f in report.findings:
+            print(f.format(show_hint=args.hints))
+        for s in report.skipped:
+            target = s.get("entry") or s.get("path")
+            print(f"skip: {target}: {s['reason']}")
+        print(
+            f"checked {len(report.checked)} entry point(s), "
+            f"skipped {len(report.skipped)}, "
+            f"found {len(report.findings)} issue(s)"
+        )
+    if report.findings:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(prog=f"repic-tpu {name}")
+    add_arguments(parser)
+    main(parser.parse_args())

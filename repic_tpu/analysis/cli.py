@@ -20,8 +20,12 @@ def add_arguments(parser: argparse.ArgumentParser) -> None:
         "AST-based JAX/TPU hygiene linter (rules RT001-RT006: jit "
         "static_argnames validity, traced-value branching, PRNG key "
         "reuse, hot-loop host syncs, recompilation hazards, "
-        "in_axes/donate arity). Exits non-zero on any finding; "
-        "suppress a line with `# repic: noqa[RTxxx]`."
+        "in_axes/donate arity) plus the RT201-RT204 project-contract "
+        "pack (atomic writes, span balance, journal outcome enum, no "
+        "bare print). Exits non-zero on any finding; suppress a line "
+        "with `# repic: noqa[RTxxx]`. With --deep, additionally runs "
+        "the trace-time semantic checker (`repic-tpu check`, rules "
+        "RT1xx) over the same paths."
     )
     parser.add_argument(
         "paths",
@@ -56,6 +60,12 @@ def add_arguments(parser: argparse.ArgumentParser) -> None:
         action="store_true",
         help="print the rule pack (ID, severity, title) and exit",
     )
+    parser.add_argument(
+        "--deep",
+        action="store_true",
+        help="also run the trace-time semantic checker (imports JAX "
+        "and the target modules; see `repic-tpu check`)",
+    )
 
 
 def main(args: argparse.Namespace) -> None:
@@ -71,10 +81,37 @@ def main(args: argparse.Namespace) -> None:
         select = {
             s.strip().upper() for s in args.select.split(",") if s.strip()
         }
-        unknown = select - {r.rule_id for r in ALL_RULES}
+        known = {r.rule_id for r in ALL_RULES}
+        if args.deep:
+            from repic_tpu.analysis.semantic import SEMANTIC_RULES
+
+            known |= set(SEMANTIC_RULES)
+        unknown = select - known
         if unknown:
             sys.exit(f"unknown rule id(s): {', '.join(sorted(unknown))}")
     findings = run_paths(args.paths, select=select)
+    if args.deep:
+        # the semantic pass imports JAX + the targets; lint alone
+        # must stay import-free, so this lives behind the flag
+        from repic_tpu.analysis.semantic import run_check
+
+        report = run_check(args.paths, select=select)
+        # both passes report a missing path as RT000 — dedupe the
+        # merge the same way run_check dedupes internally
+        seen = set()
+        merged = []
+        for f in sorted(
+            findings + report.findings,
+            key=lambda f: (f.path, f.line, f.col, f.rule),
+        ):
+            key = (f.rule, f.path, f.line, f.col, f.message)
+            if key not in seen:
+                seen.add(key)
+                merged.append(f)
+        findings = merged
+        for s in report.skipped:
+            target = s.get("entry") or s.get("path")
+            print(f"skip: {target}: {s['reason']}", file=sys.stderr)
     code = format_report(
         findings,
         fmt=args.format,

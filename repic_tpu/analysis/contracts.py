@@ -1,0 +1,169 @@
+"""Trace-time contract registry: the ``@checked`` decorator.
+
+The AST linter (:mod:`repic_tpu.analysis.rules`) cannot see shapes,
+dtypes, PartitionSpecs, or donation — the invariants that actually
+break at production scale (arXiv:2112.09017 §2: at pod scale the
+program that matters is the *compiled* one).  This module is the
+bridge: accelerator entry points declare a :class:`Contract`
+(synthetic abstract inputs, expected output avals, sharding axes,
+donated buffers) via ``@checked``, and ``repic-tpu check``
+(:mod:`repic_tpu.analysis.semantic`) verifies every registered entry
+under ``jax.eval_shape`` without running a single FLOP.
+
+Registration is import-time and FREE at call time: ``@checked``
+records the function in a module-level registry and returns it
+unchanged — no wrapper, no overhead on the jit path.  This module
+imports no JAX (contracts must be declarable from any module without
+pulling in XLA); anything JAX-flavored lives behind callables the
+checker invokes lazily.
+
+Declaring a contract (simple array-spec mode)::
+
+    from repic_tpu.analysis.contracts import Contract, checked, spec
+
+    @checked(Contract(
+        args={"xy": spec("K N 2"), "mask": spec("K N", "bool")},
+        returns=spec("N N"),
+        dims={"K": 3, "N": 8},
+    ))
+    def my_kernel(xy, mask): ...
+
+Pytree entry points (params/optimizer state) use the advanced mode:
+``example`` builds the positional input avals (may import jax/flax),
+``returns`` may be a callable mapping those input avals to the
+expected output pytree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+
+# dtype spelling is the numpy/canonical name ("float32", "int32",
+# "bool", "bfloat16"); the checker resolves it lazily via jnp.
+DEFAULT_DTYPE = "float32"
+
+
+@dataclasses.dataclass(frozen=True)
+class ArraySpec:
+    """One abstract array: shape of ints/symbols + dtype name."""
+
+    shape: tuple
+    dtype: str = DEFAULT_DTYPE
+
+
+def spec(shape, dtype: str = DEFAULT_DTYPE) -> ArraySpec:
+    """Build an :class:`ArraySpec` from ``"K N 2"`` / tuple shapes.
+
+    String shapes are whitespace-split; integer-looking tokens become
+    ints, everything else stays a symbol bound via ``Contract.dims``.
+    ``spec("")`` is a scalar.
+    """
+    if isinstance(shape, str):
+        toks = shape.split()
+        shape = tuple(
+            int(t) if t.lstrip("-").isdigit() else t for t in toks
+        )
+    return ArraySpec(shape=tuple(shape), dtype=dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Contract:
+    """What ``repic-tpu check`` verifies about one entry point.
+
+    Args:
+        args: parameter name -> :class:`ArraySpec` for the simple
+            mode; synthetic inputs are built from these in signature
+            order.  Mutually exclusive with ``example``.
+        example: zero-arg callable returning the tuple of positional
+            input avals (``jax.ShapeDtypeStruct`` or arrays) — the
+            advanced mode for pytree-taking entry points.  May import
+            jax/flax; an exception here marks the entry *skipped*
+            (environment limitation), never a finding.
+        returns: expected output — an :class:`ArraySpec`, a sequence
+            of specs (``None`` entries are unchecked), a dict of
+            field name -> spec (NamedTuple/dict outputs), or a
+            callable ``(input_avals) -> expected pytree`` of
+            ShapeDtypeStructs.  ``None`` checks trace success only.
+        dims: symbol -> concrete size used both to synthesize inputs
+            and to resolve symbols in ``returns``.
+        static: keyword arguments bound before tracing (the entry's
+            static/config knobs).
+        pspecs: parameter name -> tuple of mesh axis names (``None``
+            entries allowed) declaring how the *batched/sharded* form
+            partitions that input.  Axis names are verified against
+            the project mesh axes (RT102).
+        mesh_axes: extra legal axis names beyond the project default
+            (:data:`repic_tpu.parallel.mesh.MICROGRAPH_AXIS`).
+        donate: parameter names whose buffers the jit wrapper
+            donates; call sites re-reading such an argument after the
+            call are flagged (RT103).
+        max_trace_variants: RT105 threshold — more than this many
+            distinct static-argument signatures across call sites
+            means that many separate XLA executables.
+    """
+
+    args: dict | None = None
+    example: object = None
+    returns: object = None
+    dims: dict = dataclasses.field(default_factory=dict)
+    static: dict = dataclasses.field(default_factory=dict)
+    pspecs: dict = dataclasses.field(default_factory=dict)
+    mesh_axes: tuple = ()
+    donate: tuple = ()
+    max_trace_variants: int = 4
+
+
+@dataclasses.dataclass
+class CheckedEntry:
+    """One registered entry point (module-qualified)."""
+
+    fn: object
+    contract: Contract
+    module: str
+    qualname: str
+    lineno: int
+
+    @property
+    def canonical(self) -> str:
+        return f"{self.module}.{self.qualname}"
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+
+_REGISTRY: dict[str, CheckedEntry] = {}
+
+
+def checked(contract: Contract):
+    """Register ``fn`` (unchanged) for trace-time verification.
+
+    Stacks above ``@jax.jit`` / ``@functools.partial(jax.jit, ...)``:
+    the jitted wrapper is what gets traced, exactly as callers see it.
+    """
+
+    def wrap(fn):
+        inner = inspect.unwrap(
+            fn, stop=lambda f: not hasattr(f, "__wrapped__")
+        )
+        code = getattr(inner, "__code__", None)
+        entry = CheckedEntry(
+            fn=fn,
+            contract=contract,
+            module=getattr(fn, "__module__", "?") or "?",
+            qualname=getattr(
+                fn, "__qualname__", getattr(fn, "__name__", "?")
+            ),
+            lineno=getattr(code, "co_firstlineno", 1),
+        )
+        _REGISTRY[entry.canonical] = entry
+        return fn
+
+    return wrap
+
+
+def registry() -> dict[str, CheckedEntry]:
+    """Snapshot of every entry registered so far (keyed by canonical
+    dotted name)."""
+    return dict(_REGISTRY)

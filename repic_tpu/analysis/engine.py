@@ -424,14 +424,83 @@ def suppressed_ids(line: str) -> set | None:
     return {s.strip().upper() for s in ids.split(",") if s.strip()}
 
 
-def _is_suppressed(finding: Finding, lines: list[str]) -> bool:
-    idx = finding.line - 1
+def _line_suppresses(lines: list[str], lineno: int, rule: str) -> bool:
+    idx = lineno - 1
     if not (0 <= idx < len(lines)):
         return False
     ids = suppressed_ids(lines[idx])
     if ids is None:
         return False
-    return not ids or finding.rule in ids
+    return not ids or rule in ids
+
+
+def _is_suppressed(finding: Finding, lines: list[str]) -> bool:
+    return _line_suppresses(lines, finding.line, finding.rule)
+
+
+def function_owner_map(tree) -> dict:
+    """id(node) -> innermost enclosing function node (None=module).
+
+    Shared by the RT2xx rules (os.replace / finally:finish_run scope
+    checks) and the semantic checker's donation scan.
+    """
+    owner: dict = {}
+
+    def visit(node, fn):
+        for c in ast.iter_child_nodes(node):
+            owner[id(c)] = fn
+            nf = (
+                c
+                if isinstance(
+                    c, (ast.FunctionDef, ast.AsyncFunctionDef)
+                )
+                else fn
+            )
+            visit(c, nf)
+
+    visit(tree, None)
+    return owner
+
+
+def decorator_line_map(tree: ast.Module) -> dict:
+    """def-lineno -> decorator line range, for decorated definitions.
+
+    A ``# repic: noqa[RTxxx]`` on a decorator line must also suppress
+    findings anchored to the decorated ``def`` line — the decorator
+    (``@checked``, ``@functools.partial(jax.jit, ...)``) is usually
+    what the finding is ABOUT, and pushing the comment onto the
+    ``def`` line itself separates it from the construct it justifies.
+    """
+    out: dict[int, range] = {}
+    for node in ast.walk(tree):
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ) and node.decorator_list:
+            first = min(d.lineno for d in node.decorator_list)
+            out[node.lineno] = range(first, node.lineno)
+    return out
+
+
+def filter_suppressed(
+    findings, lines: list[str], dec_map: dict | None = None
+) -> list:
+    """Drop findings silenced by ``# repic: noqa`` comments.
+
+    Checks the finding's own line, plus — for findings anchored to a
+    decorated ``def`` line — the decorator lines above it
+    (:func:`decorator_line_map`).
+    """
+    out = []
+    for f in findings:
+        if _is_suppressed(f, lines):
+            continue
+        rng = (dec_map or {}).get(f.line)
+        if rng is not None and any(
+            _line_suppresses(lines, ln, f.rule) for ln in rng
+        ):
+            continue
+        out.append(f)
+    return out
 
 
 def analyze_source(
@@ -464,7 +533,9 @@ def analyze_source(
         if select and rule_cls.rule_id not in select:
             continue
         findings.extend(rule_cls().check(ctx))
-    findings = [f for f in findings if not _is_suppressed(f, ctx.lines)]
+    findings = filter_suppressed(
+        findings, ctx.lines, decorator_line_map(tree)
+    )
     # stable report order; dedupe identical (rule, line, col) repeats
     # that loop-body double-passes can produce
     seen = set()

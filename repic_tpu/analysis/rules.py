@@ -17,6 +17,17 @@ RT003  PRNG key consumed twice without an intervening split
 RT004  host<->device sync on jitted outputs inside a hot loop
 RT005  recompilation hazards (jit-in-loop, literal args to jit fns)
 RT006  in_axes / donate_argnums arity mismatch
+
+Project-contract rules (repic_tpu/ package files only):
+
+RT201  file writes outside runtime/atomic.py must be atomic
+RT202  span() under `with`; start_run paired with finally:finish_run
+RT203  journal.record() statuses drawn from the outcome enum
+RT204  no bare print in library code (CLI command modules exempt)
+
+Trace-time rules RT101/RT102/RT103/RT105 live in
+:mod:`repic_tpu.analysis.semantic` (``repic-tpu check``) — they need
+JAX and the imported modules, so they are a separate pass.
 """
 
 from __future__ import annotations
@@ -32,6 +43,7 @@ from repic_tpu.analysis.engine import (
     Rule,
     _const_int_tuple,
     _const_str_tuple,
+    function_owner_map as _function_owner_map,
     positional_params as _params,
 )
 
@@ -745,6 +757,288 @@ class RT006AxesArity(Rule):
                 )
 
 
+# -- RT2xx: project-contract rules ------------------------------------
+#
+# Unlike RT0xx (universal JAX hazards), these enforce THIS repo's
+# runtime invariants — the ones PRs 2-3 made load-bearing: atomic
+# artifact writes (runtime/atomic.py), balanced telemetry run scopes
+# (telemetry/__init__.py), the journal outcome enum
+# (runtime/journal.py), and structured logging (telemetry/events.py).
+# They apply only to files inside the repic_tpu package: bench
+# scripts and examples are consumers, not the runtime.
+
+
+def _in_project(ctx: ModuleContext) -> bool:
+    import re as _re
+
+    return "repic_tpu" in _re.split(r"[\\/]", ctx.path)
+
+
+def _basename(ctx: ModuleContext) -> str:
+    return ctx.path.replace("\\", "/").rsplit("/", 1)[-1]
+
+
+def _is_cli_module(ctx: ModuleContext) -> bool:
+    """The repo's subcommand protocol: module-level ``name = "..."``
+    plus a top-level ``main`` function (repic_tpu/main.py) — such a
+    module's stdout IS its product surface."""
+    has_name = any(
+        isinstance(n, ast.Assign)
+        and len(n.targets) == 1
+        and isinstance(n.targets[0], ast.Name)
+        and n.targets[0].id == "name"
+        and isinstance(n.value, ast.Constant)
+        and isinstance(n.value.value, str)
+        for n in ctx.tree.body
+    )
+    has_main = any(
+        isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n.name == "main"
+        for n in ctx.tree.body
+    )
+    return has_name and has_main
+
+
+class RT201AtomicWrite(Rule):
+    """File writes must route through the atomic-write helpers.
+
+    A plain ``open(path, "w")`` that crashes mid-write leaves a torn
+    file the resume machinery then trusts (journal entries point at
+    outputs that must be complete — docs/robustness.md).  Every
+    artifact writer goes through ``runtime.atomic.atomic_write`` or
+    the tmp + ``os.replace`` idiom; append-mode streams (journals,
+    event logs) are exempt — atomicity-by-replace cannot apply to an
+    append-only file, and a torn trailing line is handled by readers.
+    """
+
+    rule_id = "RT201"
+    severity = "error"
+    title = "file writes go through atomic helpers (project)"
+    hint = (
+        "use repic_tpu.runtime.atomic.atomic_write(path[, 'wb']), or "
+        "write to a sibling temp file and os.replace() it into place"
+    )
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        if not _in_project(ctx) or _basename(ctx) == "atomic.py":
+            return []
+        owner = _function_owner_map(ctx.tree)
+        # functions (and the module scope) that call os.replace are
+        # hand-rolled atomic writers: their temp-file opens are fine
+        replacers = set()
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and ctx.imports.resolve(node.func) == "os.replace"
+            ):
+                fn = owner.get(id(node))
+                replacers.add(id(fn) if fn is not None else None)
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and ctx.imports.resolve(node.func) in ("open", "io.open")
+            ):
+                continue
+            mode = next(
+                (k.value for k in node.keywords if k.arg == "mode"),
+                node.args[1] if len(node.args) > 1 else None,
+            )
+            if not (
+                isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)
+            ):
+                continue  # no/dynamic mode: default "r" or unknowable
+            m = mode.value
+            if not ("w" in m or "x" in m) or "a" in m:
+                continue
+            fn = owner.get(id(node))
+            if (id(fn) if fn is not None else None) in replacers:
+                continue
+            findings.append(
+                self.finding(
+                    ctx,
+                    node,
+                    f"open(..., {m!r}) writes non-atomically; an "
+                    "interrupted run leaves a torn artifact the "
+                    "journal/resume machinery will trust",
+                )
+            )
+        return findings
+
+
+class RT202SpanBalance(Rule):
+    """Telemetry scopes must be balanced by construction.
+
+    ``span()`` maintains a contextvar stack and observes duration at
+    ``__exit__`` — calling it without a ``with`` leaks the span (the
+    stack never pops, every later span mis-parents, the histogram
+    never observes).  ``telemetry.start_run`` installs a process-wide
+    event log; without ``finish_run`` in a ``finally`` an exception
+    leaves the log installed and the metric sinks unwritten.
+    """
+
+    rule_id = "RT202"
+    severity = "error"
+    title = "span() needs `with`; start_run() needs finally:finish_run"
+    hint = (
+        "write `with span(...):` (never bare), and pair "
+        "`rt = telemetry.start_run(...)` with "
+        "`finally: telemetry.finish_run(rt)` in the same function"
+    )
+
+    _SPAN = {
+        "repic_tpu.telemetry.span",
+        "repic_tpu.telemetry.events.span",
+    }
+    _START = {"repic_tpu.telemetry.start_run"}
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        if not _in_project(ctx):
+            return []
+        findings = []
+        with_exprs = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    with_exprs.add(id(item.context_expr))
+        owner = _function_owner_map(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = ctx.imports.resolve(node.func)
+            if target in self._SPAN and id(node) not in with_exprs:
+                findings.append(
+                    self.finding(
+                        ctx,
+                        node,
+                        "span() outside a `with` statement never "
+                        "exits: the span stack leaks and the "
+                        "duration histogram never observes",
+                    )
+                )
+            elif target in self._START:
+                fn = owner.get(id(node))
+                scope = fn if fn is not None else ctx.tree
+                if not self._has_finally_finish(ctx, scope):
+                    findings.append(
+                        self.finding(
+                            ctx,
+                            node,
+                            "start_run() without a `finally: "
+                            "finish_run(...)` in the same function "
+                            "leaves the run log installed when the "
+                            "run raises",
+                        )
+                    )
+        return findings
+
+    def _has_finally_finish(self, ctx, scope) -> bool:
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Try):
+                continue
+            for stmt in node.finalbody:
+                for call in ast.walk(stmt):
+                    if isinstance(call, ast.Call):
+                        t = ctx.imports.resolve(call.func) or ""
+                        if t.endswith("finish_run"):
+                            return True
+        return False
+
+
+class RT203JournalStatus(Rule):
+    """Journal outcomes must come from the allowed enum.
+
+    ``--resume`` decides what to re-process from the latest status
+    string per micrograph (runtime/journal.py DONE_STATUSES); a typo'd
+    status ("retry", "OK") is silently treated as not-done and the
+    micrograph re-processes forever.
+    """
+
+    rule_id = "RT203"
+    severity = "error"
+    title = "journal.record() status must be a known outcome"
+    hint = (
+        "use one of ok/retried/degraded/quarantined/skipped (the "
+        "constants in repic_tpu.runtime.journal); resume semantics "
+        "key on these exact strings"
+    )
+
+    _ALLOWED = {"ok", "retried", "degraded", "quarantined", "skipped"}
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        if not _in_project(ctx):
+            return []
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "record"
+                and len(node.args) >= 2
+            ):
+                continue
+            status = node.args[1]
+            if (
+                isinstance(status, ast.Constant)
+                and isinstance(status.value, str)
+                and status.value not in self._ALLOWED
+            ):
+                findings.append(
+                    self.finding(
+                        ctx,
+                        status,
+                        f"journal status {status.value!r} is not one "
+                        "of ok/retried/degraded/quarantined/skipped "
+                        "— resume will re-process this entry forever",
+                    )
+                )
+        return findings
+
+
+class RT204NoBarePrint(Rule):
+    """Library code must log through the structured logger.
+
+    A bare ``print`` bypasses the run log (the record never reaches
+    ``_events.jsonl``), ignores ``REPIC_TPU_LOG_LEVEL``, and — inside
+    the pipeline — interleaves with real CLI output.  CLI command
+    modules (the ``name``/``main`` subcommand protocol) are exempt:
+    their stdout IS the product (reports, reference-parity progress
+    lines).  ``print(..., file=...)`` is exempt too — an explicit
+    stream choice is how the structured logger itself emits.
+    """
+
+    rule_id = "RT204"
+    severity = "error"
+    title = "no bare print in library code (project)"
+    hint = (
+        "use repic_tpu.telemetry.events.get_logger(name).info(...) — "
+        "same text on stdout, plus a structured record in the run log"
+    )
+
+    def check(self, ctx: ModuleContext) -> list[Finding]:
+        if not _in_project(ctx) or _is_cli_module(ctx):
+            return []
+        findings = []
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and ctx.imports.resolve(node.func) == "print"
+            ):
+                continue
+            if any(k.arg == "file" for k in node.keywords):
+                continue
+            findings.append(
+                self.finding(
+                    ctx,
+                    node,
+                    "bare print() in library code bypasses the "
+                    "structured run log and REPIC_TPU_LOG_LEVEL",
+                )
+            )
+        return findings
+
+
 ALL_RULES = (
     RT001StaticArgnames,
     RT002TracedBranch,
@@ -752,6 +1046,10 @@ ALL_RULES = (
     RT004HotLoopSync,
     RT005RecompileHazard,
     RT006AxesArity,
+    RT201AtomicWrite,
+    RT202SpanBalance,
+    RT203JournalStatus,
+    RT204NoBarePrint,
 )
 
 RULES_BY_ID = {r.rule_id: r for r in ALL_RULES}

@@ -1,0 +1,704 @@
+"""Trace-time semantic checker: ``repic-tpu check`` (rules RT1xx).
+
+Where :mod:`repic_tpu.analysis.rules` reasons about source text, this
+pass reasons about the *traced program*: it imports the target
+modules, collects the entry points registered via ``@checked``
+(:mod:`repic_tpu.analysis.contracts`), synthesizes abstract inputs,
+and runs ``jax.eval_shape`` — shapes and dtypes are verified without
+executing a FLOP or touching an accelerator.  Sharding, donation and
+recompile-fingerprint checks ride the same registry.
+
+Rules:
+
+RT101  declared shape/dtype contract violated under ``eval_shape``
+RT102  declared PartitionSpec axis unknown to the project meshes
+RT103  donated buffer read after the donating call
+RT105  one entry traced with too many distinct static signatures
+
+Degraded modes are STRUCTURED, never tracebacks: a module that fails
+to import, an entry whose example builder needs hardware this host
+lacks, or a missing JAX are reported as ``skipped`` records (with a
+reason) and do not fail the check — CI on a CPU container must get a
+green-but-honest verdict, the same contract the journal gives
+``--resume`` (docs/robustness.md).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import functools
+import importlib
+import importlib.util
+import inspect
+import os
+import re
+import sys
+
+from repic_tpu.analysis.engine import (
+    Finding,
+    ImportMap,
+    decorator_line_map,
+    filter_suppressed,
+    function_owner_map as _owner_map,
+    iter_python_files,
+)
+
+PARTIAL = "functools.partial"
+
+# rule id -> (severity, fix hint)
+SEMANTIC_RULES = {
+    "RT101": (
+        "error",
+        "make the entry's output match its declared Contract (or fix "
+        "the contract); the declaration is what downstream sharding "
+        "and capacity planning trust",
+    ),
+    "RT102": (
+        "error",
+        "PartitionSpec axis names must come from the project mesh "
+        "(parallel/mesh.py) or the contract's mesh_axes — an unknown "
+        "axis shards nothing and fails only at dispatch time",
+    ),
+    "RT103": (
+        "error",
+        "a donated buffer is invalidated by the call; re-fetch the "
+        "result instead of re-reading the argument, or drop it from "
+        "the contract's donate tuple",
+    ),
+    "RT105": (
+        "warning",
+        "each distinct static-argument signature compiles a separate "
+        "XLA executable; hoist the static knobs into one config "
+        "object or raise max_trace_variants if the fan-out is "
+        "intentional",
+    ),
+}
+
+
+class _ContractError(Exception):
+    """A contract that cannot be synthesized (unbound symbol, ...)."""
+
+
+def _finding(rule, path, line, message, col=0) -> Finding:
+    severity, hint = SEMANTIC_RULES[rule]
+    return Finding(
+        rule=rule,
+        severity=severity,
+        message=message,
+        hint=hint,
+        path=path,
+        line=line,
+        col=col,
+    )
+
+
+@dataclasses.dataclass
+class CheckReport:
+    """Outcome of one ``repic-tpu check`` invocation."""
+
+    findings: list
+    checked: list  # [{"entry", "path", "line"}]
+    skipped: list  # [{"path" | "entry", "reason"}]
+
+    def to_json(self) -> dict:
+        return {
+            "findings": [f.to_json() for f in self.findings],
+            "checked": self.checked,
+            "skipped": self.skipped,
+        }
+
+
+# -- module discovery / import ---------------------------------------
+
+
+def _module_name_for(path: str) -> str | None:
+    """Dotted module name for a file inside a package tree, walking
+    ``__init__.py`` ancestors up to the package root; None for a
+    standalone file."""
+    path = os.path.abspath(path)
+    d, base = os.path.split(path)
+    if base == "__init__.py":
+        parts: list[str] = []
+    elif base.endswith(".py"):
+        parts = [base[:-3]]
+    else:
+        return None
+    saw_pkg = False
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        saw_pkg = True
+        d, name = os.path.split(d)
+        parts.insert(0, name)
+    return ".".join(parts) if saw_pkg and parts else None
+
+
+def _import_file(path: str, skipped: list):
+    """Import one target module; failures become structured skips."""
+    name = _module_name_for(path)
+    try:
+        if name is not None:
+            try:
+                mod = importlib.import_module(name)
+                return mod
+            except ImportError:
+                pass  # package root not importable: load by path
+        unique = "_repic_check_" + re.sub(
+            r"\W", "_", os.path.abspath(path)
+        )
+        if unique in sys.modules:
+            return sys.modules[unique]
+        spec = importlib.util.spec_from_file_location(unique, path)
+        if spec is None or spec.loader is None:
+            raise ImportError(f"no loader for {path}")
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[unique] = mod
+        try:
+            spec.loader.exec_module(mod)
+        except BaseException:
+            sys.modules.pop(unique, None)
+            raise
+        return mod
+    except KeyboardInterrupt:
+        raise  # a cancelled check must not read as green
+    except BaseException as e:
+        # a broken module must not kill check — this includes
+        # SystemExit (a guard-less script calling sys.exit at import
+        # is exactly the kind of file check gets pointed at)
+        skipped.append(
+            {
+                "path": path,
+                "reason": (
+                    f"import-error: {type(e).__name__}: {e}"
+                ),
+            }
+        )
+        return None
+
+
+def _entry_path(entry) -> str | None:
+    mod = sys.modules.get(entry.module)
+    f = getattr(mod, "__file__", None)
+    return os.path.realpath(f) if f else None
+
+
+def _entry_params(entry) -> list:
+    try:
+        return list(inspect.signature(entry.fn).parameters)
+    except (TypeError, ValueError):
+        return []
+
+
+# -- RT101: eval_shape against the declared contract ------------------
+
+
+def _np_dtype(name: str):
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import jax.numpy as jnp
+
+        return np.dtype(getattr(jnp, name))
+
+
+def _resolve_shape(shape, dims) -> tuple:
+    out = []
+    for s in shape:
+        if isinstance(s, str):
+            if s not in dims:
+                raise _ContractError(
+                    f"shape symbol {s!r} is not bound in dims"
+                )
+            out.append(int(dims[s]))
+        else:
+            out.append(int(s))
+    return tuple(out)
+
+
+def _synthesize(contract) -> dict:
+    """Keyword avals for the simple (args=...) contract mode."""
+    import jax
+
+    if contract.args is None:
+        raise _ContractError("contract declares neither args nor example")
+    avals = {}
+    for name, sp in contract.args.items():
+        if sp is None:
+            raise _ContractError(f"arg {name!r} has no ArraySpec")
+        avals[name] = jax.ShapeDtypeStruct(
+            _resolve_shape(sp.shape, contract.dims),
+            _np_dtype(sp.dtype),
+        )
+    return avals
+
+
+def _leaf_mismatch(label, got, sp, dims):
+    """Compare one output leaf against one ArraySpec; message or None."""
+    want_shape = _resolve_shape(sp.shape, dims)
+    got_shape = tuple(getattr(got, "shape", ()))
+    if got_shape != want_shape:
+        return (
+            f"output {label} has shape {got_shape}, contract "
+            f"declares {want_shape}"
+        )
+    if sp.dtype is not None:
+        got_dt = str(getattr(got, "dtype", "?"))
+        if got_dt != str(_np_dtype(sp.dtype)):
+            return (
+                f"output {label} has dtype {got_dt}, contract "
+                f"declares {sp.dtype}"
+            )
+    return None
+
+
+def _compare_returns(entry, out, in_avals, findings):
+    from repic_tpu.analysis.contracts import ArraySpec
+
+    contract = entry.contract
+    ret = contract.returns
+    path = _entry_path(entry) or entry.module
+    if ret is None:
+        return
+
+    def emit(msg):
+        findings.append(
+            _finding(
+                "RT101", path, entry.lineno,
+                f"{entry.name}(): {msg}",
+            )
+        )
+
+    if isinstance(ret, ArraySpec):
+        msg = _leaf_mismatch("value", out, ret, contract.dims)
+        if msg:
+            emit(msg)
+        return
+    if callable(ret):
+        import jax
+
+        expected = ret(in_avals)
+        got_leaves = jax.tree_util.tree_leaves(out)
+        want_leaves = jax.tree_util.tree_leaves(expected)
+        if len(got_leaves) != len(want_leaves):
+            emit(
+                f"output has {len(got_leaves)} array leaves, "
+                f"contract expects {len(want_leaves)}"
+            )
+            return
+        for i, (g, w) in enumerate(zip(got_leaves, want_leaves)):
+            gs, ws = tuple(g.shape), tuple(w.shape)
+            if gs != ws or str(g.dtype) != str(w.dtype):
+                emit(
+                    f"output leaf {i} is {gs}/{g.dtype}, contract "
+                    f"expects {ws}/{w.dtype}"
+                )
+        return
+    if isinstance(ret, dict):
+        got_map = (
+            out._asdict() if hasattr(out, "_asdict") else dict(out)
+        )
+        for field, sp in ret.items():
+            if sp is None:
+                continue
+            if field not in got_map:
+                emit(f"output has no field {field!r}")
+                continue
+            msg = _leaf_mismatch(
+                f"field {field!r}", got_map[field], sp, contract.dims
+            )
+            if msg:
+                emit(msg)
+        return
+    # positional sequence of specs (None entries unchecked)
+    got_seq = list(out) if isinstance(out, (tuple, list)) else [out]
+    if len(got_seq) != len(ret):
+        emit(
+            f"output has {len(got_seq)} entries, contract declares "
+            f"{len(ret)}"
+        )
+        return
+    for i, sp in enumerate(ret):
+        if sp is None:
+            continue
+        msg = _leaf_mismatch(f"[{i}]", got_seq[i], sp, contract.dims)
+        if msg:
+            emit(msg)
+
+
+def _check_entry(entry, findings: list, skipped: list) -> None:
+    """RT101 for one entry: synthesize, trace, compare."""
+    import jax
+
+    contract = entry.contract
+    path = _entry_path(entry) or entry.module
+    try:
+        if contract.example is not None:
+            try:
+                in_avals = tuple(contract.example())
+            except Exception as e:  # env-dependent builder: skip
+                skipped.append(
+                    {
+                        "entry": entry.canonical,
+                        "reason": (
+                            "example-unavailable: "
+                            f"{type(e).__name__}: {e}"
+                        ),
+                    }
+                )
+                return
+            fn = functools.partial(entry.fn, **contract.static)
+            out = jax.eval_shape(fn, *in_avals)
+        else:
+            kw_avals = _synthesize(contract)
+            fn = functools.partial(entry.fn, **contract.static)
+            out = jax.eval_shape(fn, **kw_avals)
+            in_avals = tuple(kw_avals.values())
+    except _ContractError as e:
+        findings.append(
+            _finding(
+                "RT101", path, entry.lineno,
+                f"{entry.name}(): unusable contract — {e}",
+            )
+        )
+        return
+    except (RuntimeError, OSError) as e:
+        # environment limitation (no backend, no mesh, missing
+        # hardware API) — a structured skip, not a finding
+        skipped.append(
+            {
+                "entry": entry.canonical,
+                "reason": f"trace-unavailable: {type(e).__name__}: {e}",
+            }
+        )
+        return
+    except Exception as e:
+        findings.append(
+            _finding(
+                "RT101", path, entry.lineno,
+                f"{entry.name}(): trace failed under the declared "
+                f"contract — {type(e).__name__}: {e}",
+            )
+        )
+        return
+    _compare_returns(entry, out, in_avals, findings)
+
+
+# -- RT102: sharding axis names ---------------------------------------
+
+
+def _project_mesh_axes() -> set:
+    try:
+        from repic_tpu.parallel.mesh import mesh_axis_names
+
+        return set(mesh_axis_names())
+    except Exception:
+        return set()
+
+
+def _check_sharding(entry, findings: list) -> None:
+    contract = entry.contract
+    if not contract.pspecs:
+        return
+    path = _entry_path(entry) or entry.module
+    known = _project_mesh_axes() | set(contract.mesh_axes)
+    params = set(_entry_params(entry))
+    for arg, axes in contract.pspecs.items():
+        if params and arg not in params:
+            findings.append(
+                _finding(
+                    "RT102", path, entry.lineno,
+                    f"{entry.name}(): pspec declared for unknown "
+                    f"parameter {arg!r}",
+                )
+            )
+            continue
+        for ax in axes:
+            if ax is None:
+                continue
+            if ax not in known:
+                findings.append(
+                    _finding(
+                        "RT102", path, entry.lineno,
+                        f"{entry.name}(): PartitionSpec axis {ax!r} "
+                        f"(parameter {arg!r}) is not a known mesh "
+                        f"axis {sorted(known)}",
+                    )
+                )
+
+
+# -- call-site scans: RT103 (donation) and RT105 (variants) -----------
+
+
+def _call_sites(entry, tree, imap, path, entry_paths):
+    """Yield ``(call, args, keywords)`` for calls of ``entry`` in one
+    parsed file — direct calls, ``functools.partial`` applications,
+    and bare-name calls inside the entry's own defining module."""
+    local = entry_paths.get(entry.canonical) == os.path.realpath(path)
+    for call in ast.walk(tree):
+        if not isinstance(call, ast.Call):
+            continue
+        tgt = imap.resolve(call.func)
+        if tgt == entry.canonical or (
+            local and tgt == entry.qualname
+        ):
+            yield call, list(call.args), list(call.keywords)
+        elif tgt == PARTIAL and call.args:
+            inner = imap.resolve(call.args[0])
+            if inner == entry.canonical or (
+                local and inner == entry.qualname
+            ):
+                yield call, list(call.args[1:]), list(call.keywords)
+
+
+def _stmt_map(scope) -> dict:
+    """id(node) -> nearest enclosing statement inside ``scope``."""
+    out: dict = {}
+
+    def visit(node, stmt):
+        for c in ast.iter_child_nodes(node):
+            s = c if isinstance(c, ast.stmt) else stmt
+            out[id(c)] = s
+            visit(c, s)
+
+    visit(scope, None)
+    return out
+
+
+def _donation_findings(entry, tree, imap, path, entry_paths, findings):
+    contract = entry.contract
+    if not contract.donate:
+        return
+    params = _entry_params(entry)
+    owner = _owner_map(tree)
+    stmt_maps: dict = {}  # id(scope) -> _stmt_map(scope), per call
+    for call, args, keywords in _call_sites(
+        entry, tree, imap, path, entry_paths
+    ):
+        scope = owner.get(id(call)) or tree
+        stmts = stmt_maps.get(id(scope))
+        if stmts is None:
+            stmts = stmt_maps[id(scope)] = _stmt_map(scope)
+        for pname in contract.donate:
+            expr = next(
+                (k.value for k in keywords if k.arg == pname), None
+            )
+            if expr is None and pname in params:
+                i = params.index(pname)
+                if i < len(args):
+                    expr = args[i]
+            if not isinstance(expr, ast.Name):
+                continue
+            stmt = stmts.get(id(call))
+            # `buf = consume(buf)` rebinds the donated name with the
+            # result — execution order is value-then-target, so the
+            # Store happens after donation and later reads are fine
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                targets = [stmt.target]
+            if any(
+                isinstance(n, ast.Name) and n.id == expr.id
+                for t in targets
+                for n in ast.walk(t)
+            ):
+                continue
+            end_line = getattr(
+                stmt if stmt is not None else call, "end_lineno",
+                call.lineno,
+            )
+            uses = sorted(
+                (
+                    n
+                    for n in ast.walk(scope)
+                    if isinstance(n, ast.Name)
+                    and n.id == expr.id
+                    and n.lineno > end_line
+                ),
+                key=lambda n: (n.lineno, n.col_offset),
+            )
+            for n in uses:
+                if isinstance(n.ctx, ast.Store):
+                    break  # rebound: later reads see a fresh value
+                if isinstance(n.ctx, ast.Load):
+                    findings.append(
+                        _finding(
+                            "RT103", path, n.lineno,
+                            f"buffer {expr.id!r} is read after "
+                            f"{entry.name}() donates it "
+                            f"(donate declares parameter "
+                            f"{pname!r})",
+                            col=n.col_offset,
+                        )
+                    )
+                    break
+
+
+def _variant_fingerprint(args, keywords):
+    pos = tuple(
+        (i, repr(a.value))
+        for i, a in enumerate(args)
+        if isinstance(a, ast.Constant)
+    )
+    kw = tuple(
+        sorted(
+            (k.arg, repr(k.value.value))
+            for k in keywords
+            if k.arg and isinstance(k.value, ast.Constant)
+        )
+    )
+    return pos, kw
+
+
+def _variant_findings(entries, parsed, entry_paths, findings):
+    """RT105: count distinct static-argument signatures per entry."""
+    for entry in entries:
+        variants: dict = {}
+        for path, (tree, imap, _src) in parsed.items():
+            for call, args, keywords in _call_sites(
+                entry, tree, imap, path, entry_paths
+            ):
+                fp = _variant_fingerprint(args, keywords)
+                variants.setdefault(fp, (path, call.lineno))
+        limit = entry.contract.max_trace_variants
+        if len(variants) > limit:
+            findings.append(
+                _finding(
+                    "RT105",
+                    _entry_path(entry) or entry.module,
+                    entry.lineno,
+                    f"{entry.name}() is called with {len(variants)} "
+                    f"distinct static-argument signatures (contract "
+                    f"allows {limit}) — each signature traces and "
+                    f"compiles separately",
+                )
+            )
+
+
+# -- driver -----------------------------------------------------------
+
+
+def run_check(paths, select=None, collect_only=False) -> CheckReport:
+    """Run the semantic pass over ``paths`` (files or directories).
+
+    ``select`` restricts to a set of RT1xx rule ids; ``collect_only``
+    imports and registers entries without checking (``--list-entries``).
+    """
+    from repic_tpu.analysis import contracts
+
+    findings: list[Finding] = []
+    skipped: list[dict] = []
+    checked: list[dict] = []
+    missing: list[str] = []
+    files = [
+        p
+        for p in iter_python_files(paths, missing=missing)
+        if os.path.basename(p) != "__main__.py"
+    ]
+    for p in missing:
+        findings.append(
+            Finding(
+                rule="RT000", severity="error",
+                message="path does not exist", hint="",
+                path=p, line=1, col=0,
+            )
+        )
+    try:
+        import jax  # noqa: F401
+    except Exception as e:  # degraded: no JAX in this environment
+        skipped.extend(
+            {
+                "path": p,
+                "reason": f"jax-unavailable: {type(e).__name__}: {e}",
+            }
+            for p in files
+        )
+        return CheckReport(findings, checked, skipped)
+
+    for path in files:
+        _import_file(path, skipped)
+
+    file_set = {os.path.realpath(p) for p in files}
+    entries = sorted(
+        (
+            e
+            for e in contracts.registry().values()
+            if _entry_path(e) in file_set
+        ),
+        key=lambda e: (e.module, e.lineno),
+    )
+    entry_paths = {e.canonical: _entry_path(e) for e in entries}
+    for entry in entries:
+        checked.append(
+            {
+                "entry": entry.canonical,
+                "path": _entry_path(entry) or entry.module,
+                "line": entry.lineno,
+            }
+        )
+    if collect_only:
+        return CheckReport(findings, checked, skipped)
+
+    def want(rule):
+        return select is None or rule in select
+
+    for entry in entries:
+        if want("RT102"):
+            _check_sharding(entry, findings)
+        if want("RT101"):
+            _check_entry(entry, findings, skipped)
+
+    # parse once for the call-site scans and noqa suppression
+    parsed = {}
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+        except (OSError, SyntaxError, UnicodeDecodeError):
+            continue  # the AST linter owns reporting these
+        parsed[path] = (tree, ImportMap(tree), src)
+
+    if want("RT103"):
+        for entry in entries:
+            for path, (tree, imap, _src) in parsed.items():
+                _donation_findings(
+                    entry, tree, imap, path, entry_paths, findings
+                )
+    if want("RT105"):
+        _variant_findings(
+            [e for e in entries], parsed, entry_paths, findings
+        )
+
+    # honor `# repic: noqa[RTxxx]` like the AST linter does
+    by_path: dict[str, list] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    parsed_real = {
+        os.path.realpath(p): v for p, v in parsed.items()
+    }
+    kept: list[Finding] = []
+    for path, group in by_path.items():
+        entry_src = parsed.get(path) or parsed_real.get(
+            os.path.realpath(path)
+        )
+        if entry_src is None:
+            kept.extend(group)
+            continue
+        tree, _imap, src = entry_src
+        kept.extend(
+            filter_suppressed(
+                group, src.splitlines(), decorator_line_map(tree)
+            )
+        )
+    seen = set()
+    out = []
+    for f in sorted(
+        kept, key=lambda f: (f.path, f.line, f.col, f.rule)
+    ):
+        key = (f.rule, f.path, f.line, f.col, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return CheckReport(out, checked, skipped)

@@ -41,6 +41,7 @@ import time
 
 import numpy as np
 
+from repic_tpu.runtime.atomic import atomic_write
 from repic_tpu.utils import box_io
 
 name = "get_cliques"
@@ -269,13 +270,16 @@ def main(args):
                     a_mat,
                 ],
             ):
-                with open(
-                    os.path.join(args.out_dir, f"{mname}_{label}.pickle"), "wb"
+                with atomic_write(
+                    os.path.join(
+                        args.out_dir, f"{mname}_{label}.pickle"
+                    ),
+                    "wb",
                 ) as o:
                     pickle.dump(val, o, protocol=pickle.HIGHEST_PROTOCOL)
 
-            with open(
-                os.path.join(args.out_dir, f"{mname}_runtime.tsv"), "wt"
+            with atomic_write(
+                os.path.join(args.out_dir, f"{mname}_runtime.tsv")
             ) as o:
                 runtime = per_micro_runtime + (time.time() - t0)
                 o.write(

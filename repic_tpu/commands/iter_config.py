@@ -143,7 +143,9 @@ def main(args):
     }
     params["compute_dtype"] = "bfloat16" if args.bf16 else "float32"
     print(f"Writing config file to {args.out_file_path}")
-    with open(args.out_file_path, "wt") as o:
+    from repic_tpu.runtime.atomic import atomic_write
+
+    with atomic_write(args.out_file_path) as o:
         json.dump(params, o, indent=4)
 
 

@@ -73,7 +73,9 @@ def add_arguments(parser) -> None:
 def _write_star(path: str, coords: np.ndarray) -> None:
     """RELION particle STAR with centers + score, mirroring the
     vendored picker's writer (autoPicker.py:278+)."""
-    with open(path, "wt") as f:
+    from repic_tpu.runtime.atomic import atomic_write
+
+    with atomic_write(path) as f:
         f.write("\ndata_\n\nloop_\n")
         f.write("_rlnCoordinateX #1\n_rlnCoordinateY #2\n")
         f.write("_rlnAutopickFigureOfMerit #3\n")

@@ -32,6 +32,7 @@ COMMANDS = {
     "build_subsets": "repic_tpu.utils.subsets",
     "get_examples": "repic_tpu.commands.get_examples",
     "lint": "repic_tpu.analysis.cli",
+    "check": "repic_tpu.analysis.check_cli",
     "report": "repic_tpu.commands.report",
 }
 

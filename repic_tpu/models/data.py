@@ -352,7 +352,9 @@ def extract_dataset(
         )
         positives.extend(list(pos))
         negatives.extend(list(neg))
-    with open(out_pickle, "wb") as f:
+    from repic_tpu.runtime.atomic import atomic_write
+
+    with atomic_write(out_pickle, "wb") as f:
         pickle.dump((positives, negatives), f)
     return len(positives), len(negatives)
 

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from repic_tpu.analysis.contracts import Contract, checked
 from repic_tpu.models import preprocess as pp
 from repic_tpu.models.cnn import (
     FCN_STRIDE,
@@ -54,6 +55,36 @@ def score_grid_shape(shape, patch_size: int, step: int = STEP_SIZE):
     )
 
 
+def _score_patches_example():
+    """Synthetic (params, img) avals for the @checked contract:
+    default-arch PickerCNN params (abstract init — no FLOPs) plus a
+    128x128 preprocessed micrograph."""
+    params = jax.eval_shape(
+        lambda: PickerCNN().init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, PATCH_SIZE, PATCH_SIZE, 1)),
+        )["params"]
+    )
+    return params, jax.ShapeDtypeStruct((128, 128), jnp.float32)
+
+
+_SCORE_STATIC = {"patch_size": 16, "step": STEP_SIZE}
+
+
+@checked(Contract(
+    example=_score_patches_example,
+    # the score map is (out_h, out_w) f32 — the sliding-window grid
+    # of the input image at the static patch/stride
+    returns=lambda avals: jax.ShapeDtypeStruct(
+        score_grid_shape(
+            avals[1].shape,
+            _SCORE_STATIC["patch_size"],
+            _SCORE_STATIC["step"],
+        ),
+        jnp.float32,
+    ),
+    static=_SCORE_STATIC,
+))
 @functools.partial(
     jax.jit,
     static_argnames=("patch_size", "step", "norm", "arch", "dtype"),

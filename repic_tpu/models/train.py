@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +31,7 @@ import numpy as np
 import optax
 
 from repic_tpu import telemetry
+from repic_tpu.analysis.contracts import Contract, checked
 from repic_tpu.models.cnn import (
     PickerCNN,
     arch_kwargs,
@@ -42,6 +44,8 @@ from repic_tpu.telemetry import events as tlm_events
 # host-sync cadence.  Each loss/eval fetch is a host<->device round
 # trip — the counter makes an accidental per-step fetch regression
 # (RT004 territory) visible in the run report.
+_log = tlm_events.get_logger("train")
+
 _STEPS_PER_SEC = telemetry.gauge(
     "repic_train_steps_per_sec",
     "training steps per wall-clock second, updated per epoch",
@@ -106,6 +110,63 @@ def _make_update_step(model, tx):
         return params, opt_state, loss, logits
 
     return update
+
+
+@lru_cache(maxsize=1)
+def _default_update_step():
+    """The reference-protocol update step at default configuration
+    (deep arch, SGD 0.01/momentum 0.9) — one shared jit wrapper."""
+    model = PickerCNN(**arch_kwargs("deep"))
+    tx = optax.sgd(
+        TrainConfig.learning_rate, momentum=TrainConfig.momentum
+    )
+    return _make_update_step(model, tx)
+
+
+def _train_step_example():
+    """Synthetic avals for the @checked train-step contract: params/
+    optimizer pytrees from abstract init, one 8-patch batch."""
+    model = PickerCNN(**arch_kwargs("deep"))
+    tx = optax.sgd(
+        TrainConfig.learning_rate, momentum=TrainConfig.momentum
+    )
+    params = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 1))
+        )["params"]
+    )
+    opt_state = jax.eval_shape(tx.init, params)
+    return (
+        params,
+        opt_state,
+        jax.ShapeDtypeStruct((8, 64, 64, 1), jnp.float32),
+        jax.ShapeDtypeStruct((8,), jnp.int32),
+        jax.eval_shape(lambda: jax.random.PRNGKey(0)),
+    )
+
+
+@checked(Contract(
+    example=_train_step_example,
+    # one SGD update is shape-preserving on params and optimizer
+    # state; loss is a f32 scalar, logits are (B, 2) f32
+    returns=lambda avals: (
+        avals[0],
+        avals[1],
+        jax.ShapeDtypeStruct((), jnp.float32),
+        jax.ShapeDtypeStruct((avals[2].shape[0], 2), jnp.float32),
+    ),
+))
+def train_step(params, opt_state, batch, labels, dropout_rng):
+    """One jitted update of the default-configuration picker.
+
+    The checkable module-level form of the closure
+    :func:`_make_update_step` builds per (model, tx): `repic-tpu
+    check` traces THIS entry, and it shares the jit wrapper across
+    calls via :func:`_default_update_step`.
+    """
+    return _default_update_step()(
+        params, opt_state, batch, labels, dropout_rng
+    )
 
 
 def _make_eval_step(model):
@@ -267,10 +328,10 @@ def fit(
             )
             if config.verbose and epochs_run % config.log_every == 0:
                 dt = time.time() - t0
-                print(
+                _log.info(
                     f"epoch {epochs_run}: loss {loss_val:.4f} "
-                    f"train_err {train_err:.2f}% val_err {val_err:.2f}% "
-                    f"({dt:.1f}s)"
+                    f"train_err {train_err:.2f}% "
+                    f"val_err {val_err:.2f}% ({dt:.1f}s)"
                 )
             if val_err < best_val:
                 best_val = val_err
@@ -282,7 +343,7 @@ def fit(
                 patience -= 1
             if patience == 0:
                 if config.verbose:
-                    print(
+                    _log.info(
                         f"validation error has not improved in "
                         f"{config.patience} epochs; stopping"
                     )

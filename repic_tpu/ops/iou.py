@@ -22,6 +22,8 @@ every micrograph in the batch.
 import jax
 import jax.numpy as jnp
 
+from repic_tpu.analysis.contracts import Contract, checked, spec
+
 
 def pair_iou(
     xy_a: jax.Array, xy_b: jax.Array, box_size, box_size_b=None
@@ -68,6 +70,17 @@ def pair_iou_xy(xa, ya, xb, yb, box_size, box_size_b=None) -> jax.Array:
     return inter / (sa * sa + sb * sb - inter)
 
 
+@checked(Contract(
+    args={
+        "xy_a": spec("N 2"),
+        "mask_a": spec("N", "bool"),
+        "xy_b": spec("M 2"),
+        "mask_b": spec("M", "bool"),
+        "box_size": spec(""),
+    },
+    returns=spec("N M"),
+    dims={"N": 8, "M": 5},
+))
 def pairwise_iou_matrix(
     xy_a, mask_a, xy_b, mask_b, box_size, box_size_b=None
 ) -> jax.Array:

@@ -31,6 +31,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from repic_tpu import telemetry
+from repic_tpu.analysis.contracts import Contract, checked, spec
+
+# Shared trace-time contract of the two device solver rungs
+# (`repic-tpu check`): (C, K) int32 vertex ids + (C,) weights/mask ->
+# (C,) bool picks.  V (num_vertices) is the static vertex-space size.
+_SOLVER_CONTRACT = Contract(
+    args={
+        "member_vertex": spec("C K", "int32"),
+        "w": spec("C"),
+        "valid": spec("C", "bool"),
+    },
+    returns=spec("C", "bool"),
+    dims={"C": 16, "K": 3},
+    static={"num_vertices": 48},
+)
 
 # Budget telemetry (docs/observability.md): every budget exhaustion
 # is a degradation the runtime ladder will absorb — operators watch
@@ -55,6 +70,7 @@ class SolverBudgetExceeded(RuntimeError):
     """
 
 
+@checked(_SOLVER_CONTRACT)
 def solve_greedy(
     member_vertex: jax.Array,
     w: jax.Array,
@@ -141,6 +157,7 @@ def solve_greedy(
     return picked
 
 
+@checked(_SOLVER_CONTRACT)
 def solve_lp_rounding(
     member_vertex: jax.Array,
     w: jax.Array,

@@ -45,3 +45,15 @@ def shard_over_micrographs(mesh: Mesh, *arrays):
 
 def micrograph_pspec() -> P:
     return P(MICROGRAPH_AXIS)
+
+
+def mesh_axis_names() -> tuple:
+    """Every mesh axis name this project shards over.
+
+    The single source of truth for the trace-time sharding check
+    (`repic-tpu check` rule RT102): a PartitionSpec axis declared by
+    an ``@checked`` contract must appear here (or in the contract's
+    own ``mesh_axes``) — an axis name the meshes never define shards
+    nothing and fails only at dispatch time.
+    """
+    return (MICROGRAPH_AXIS,)

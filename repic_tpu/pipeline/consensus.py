@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from repic_tpu import telemetry
+from repic_tpu.analysis.contracts import Contract, checked, spec
 from repic_tpu.ops.cliques import (
     DEFAULT_THRESHOLD,
     compact_cliques,
@@ -95,6 +96,38 @@ class ConsensusResult(NamedTuple):
     max_partial: jax.Array | int = 0
 
 
+@checked(Contract(
+    # trace-time contract (`repic-tpu check`): K picker rows of N
+    # padded particles in, Cmax (= clique_capacity) padded cliques
+    # out.  pspecs declare how make_batched_consensus shards the
+    # vmapped batch axis — names verified against parallel/mesh.py.
+    args={
+        "xy": spec("K N 2"),
+        "conf": spec("K N"),
+        "mask": spec("K N", "bool"),
+        "box_size": spec(""),
+    },
+    returns={
+        "rep_xy": spec("C 2"),
+        "confidence": spec("C"),
+        "w": spec("C"),
+        "member_idx": spec("C K", "int32"),
+        "rep_slot": spec("C", "int32"),
+        "picked": spec("C", "bool"),
+        "valid": spec("C", "bool"),
+        "num_cliques": spec("", "int32"),
+        "max_adjacency": spec("", "int32"),
+        "max_partial": spec("", "int32"),
+    },
+    dims={"K": 3, "N": 8, "C": 64},
+    static={"clique_capacity": 64, "max_neighbors": 4},
+    pspecs={
+        "xy": (MICROGRAPH_AXIS,),
+        "conf": (MICROGRAPH_AXIS,),
+        "mask": (MICROGRAPH_AXIS,),
+    },
+    max_trace_variants=4,
+))
 def consensus_one(
     xy: jax.Array,
     conf: jax.Array,

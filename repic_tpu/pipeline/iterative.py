@@ -34,7 +34,10 @@ import numpy as np
 
 from repic_tpu.pipeline import pickers as pickers_mod
 from repic_tpu.pipeline.consensus import run_consensus_dir
+from repic_tpu.telemetry import events as tlm_events
 from repic_tpu.utils.box_io import read_box, write_box
+
+_log = tlm_events.get_logger("iter_pick")
 
 SPLITS = ("train", "val", "test")
 
@@ -51,7 +54,7 @@ class IterativeState:
     def log(self, msg: str) -> None:
         stamp = time.strftime("%Y-%m-%d %H:%M:%S")
         line = f"[{stamp}] {msg}"
-        print(line)
+        _log.info(msg)
         with open(
             os.path.join(self.out_dir, "iter_pick.log"), "at"
         ) as f:

@@ -32,6 +32,7 @@ import subprocess
 from dataclasses import dataclass, field
 
 from repic_tpu import telemetry
+from repic_tpu.runtime.atomic import atomic_write
 from repic_tpu.telemetry import events as tlm_events
 
 # Per-host picker telemetry (docs/observability.md): in a multi-host
@@ -260,7 +261,7 @@ class ExternalPicker:
             full, capture_output=True, text=True, env=env
         )
         if log_path:
-            with open(log_path, "wt") as f:
+            with atomic_write(log_path) as f:
                 f.write(out.stdout)
                 f.write(out.stderr)
         if out.returncode != 0:
@@ -305,7 +306,7 @@ class CryoloPicker(ExternalPicker):
                 "valid_image_folder": val_mrc,
                 "valid_annot_folder": val_box,
             }
-        with open(path, "wt") as f:
+        with atomic_write(path) as f:
             json.dump(cfg, f, indent=2)
 
     def predict_cmd(self, mrc_dir, out_dir, config_json):
@@ -658,7 +659,7 @@ def _box_dir_to_topaz_tsv(box_dir, out_tsv, box_size, scale) -> int:
             cx = (float(x) + box_size / 2.0) / scale
             cy = (float(y) + box_size / 2.0) / scale
             rows.append((stem, int(round(cx)), int(round(cy))))
-    with open(out_tsv, "wt") as f:
+    with atomic_write(out_tsv) as f:
         f.write("image_name\tx_coord\ty_coord\n")
         for stem, x, y in rows:
             f.write(f"{stem}\t{x}\t{y}\n")

@@ -338,7 +338,12 @@ def write_star(df, out_path, force=False) -> None:
     for canon, label in STAR_LABELS.items():
         if canon in cols:
             lines += f"{label} #{cols.index(canon) + 1}\n"
-    with open(out_path, "wt") as f:
+    from repic_tpu.runtime.atomic import atomic_write
+
+    # atomic header publish, then pandas appends the rows; a crash
+    # between the two leaves a valid (header-only) STAR, not a torn
+    # byte prefix
+    with atomic_write(out_path) as f:
         f.write(lines)
     df.to_csv(out_path, header=False, sep="\t", index=False, mode="a")
 

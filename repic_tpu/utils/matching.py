@@ -158,9 +158,11 @@ def write_results_txt(analysis, out_dir) -> str:
     """The reference's ``results.txt`` surface, byte-compatible
     (autoPicker.py:427-462): five CSV rows, counts, row legend, then
     precision/recall sampled at each multiple of the reference count."""
+    from repic_tpu.runtime.atomic import atomic_write
+
     out_file = os.path.join(out_dir, "results.txt")
     a = analysis
-    with open(out_file, "wt") as f:
+    with atomic_write(out_file) as f:
         f.write(",".join(map(str, a["tp"])) + "\n")
         f.write(",".join(map(str, a["recall"])) + "\n")
         f.write(",".join(map(str, a["precision"])) + "\n")

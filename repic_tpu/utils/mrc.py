@@ -108,7 +108,9 @@ def write_mrc(path: str, data: np.ndarray) -> None:
     header[19:22] = stats.view(np.int32)
     header[52] = int.from_bytes(b"MAP ", "little")
     header[53] = 0x00004444  # little-endian machine stamp
-    with open(path, "wb") as f:
+    from repic_tpu.runtime.atomic import atomic_write
+
+    with atomic_write(path, "wb") as f:
         f.write(header.tobytes())
         f.write(data.tobytes())
 

@@ -288,8 +288,10 @@ def score_distance_files(
 def write_scores_tsv(rows, out_dir) -> str:
     """``particle_set_comp.tsv`` output surface
     (reference: score_detections.py:139-143)."""
+    from repic_tpu.runtime.atomic import atomic_write
+
     out_file = os.path.join(out_dir, "particle_set_comp.tsv")
-    with open(out_file, "wt") as o:
+    with atomic_write(out_file) as o:
         o.write("\t".join(
             ["filename", "precision", "recall", "f1", "pos_frac"]) + "\n")
         for entry in rows:

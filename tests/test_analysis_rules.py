@@ -1,4 +1,4 @@
-"""Unit fixtures for the RT001-RT006 rule pack.
+"""Unit fixtures for the RT001-RT006 + RT201-RT204 rule packs.
 
 One positive and one negative snippet per rule, asserting the rule ID
 and the exact reported line, plus a mechanical suppression check: for
@@ -7,14 +7,21 @@ flagged line must silence exactly that finding.  These fixtures are
 the rule pack's contract — tightening a rule that breaks one of the
 negatives means the rule now false-positives on an idiom this
 codebase relies on (periodic logging guards, static-argname
-branching, shape reads).
+branching, shape reads, append-mode journals, CLI stdout).
+
+The RT2xx project-contract rules apply only inside the repic_tpu
+package, so every fixture is analyzed under a ``repic_tpu/``-prefixed
+virtual path; the scoping test pins that bench/scripts files are NOT
+in scope.
 """
 
+import ast
 import textwrap
 
 import pytest
 
 from repic_tpu.analysis import analyze_source
+from repic_tpu.analysis.engine import Rule
 
 # Each entry: (rule_id, positive_source, expected_line,
 #              negative_source)
@@ -157,6 +164,78 @@ CASES = {
         batched = jax.vmap(one, in_axes=(0, 0, None))
         """,
     ),
+    "RT201": (
+        """
+        def save(path, rows):
+            with open(path, "wt") as f:
+                f.write("x")
+        """,
+        2,
+        """
+        import os
+
+        def save(path, rows):
+            tmp = path + ".tmp"
+            with open(tmp, "wt") as f:
+                f.write("x")
+            os.replace(tmp, path)
+
+        def append(path, line):
+            with open(path, "at") as f:
+                f.write(line)
+        """,
+    ),
+    "RT202": (
+        """
+        from repic_tpu.telemetry import events as tlm_events
+
+        def run(xs):
+            s = tlm_events.span("load", n=len(xs))
+            return s
+        """,
+        4,
+        """
+        from repic_tpu.telemetry import events as tlm_events
+
+        def run(xs):
+            with tlm_events.span("load", n=len(xs)):
+                return list(xs)
+        """,
+    ),
+    "RT203": (
+        """
+        def finish(journal, name):
+            journal.record(name, "OK", out=name)
+        """,
+        2,
+        """
+        def finish(journal, name):
+            journal.record(name, "ok", out=name)
+            journal.record(name, "quarantined", error={})
+        """,
+    ),
+    "RT204": (
+        """
+        def run(x):
+            print(x)
+            return x
+        """,
+        2,
+        """
+        import sys
+
+        name = "demo"
+
+
+        def add_arguments(parser):
+            pass
+
+
+        def main(args):
+            print(args)
+            print("err", file=sys.stderr)
+        """,
+    ),
 }
 
 
@@ -167,7 +246,9 @@ def _src(s: str) -> str:
 @pytest.mark.parametrize("rule_id", sorted(CASES))
 def test_positive_fires_at_line(rule_id):
     source, line, _ = CASES[rule_id]
-    findings = analyze_source(_src(source), f"{rule_id}_pos.py")
+    findings = analyze_source(
+        _src(source), f"repic_tpu/{rule_id}_pos.py"
+    )
     hits = [f for f in findings if f.rule == rule_id]
     assert hits, f"{rule_id} did not fire; got {findings}"
     assert hits[0].line == line, (
@@ -179,7 +260,9 @@ def test_positive_fires_at_line(rule_id):
 @pytest.mark.parametrize("rule_id", sorted(CASES))
 def test_negative_is_clean(rule_id):
     _, _, source = CASES[rule_id]
-    findings = analyze_source(_src(source), f"{rule_id}_neg.py")
+    findings = analyze_source(
+        _src(source), f"repic_tpu/{rule_id}_neg.py"
+    )
     hits = [f for f in findings if f.rule == rule_id]
     assert not hits, [f.format() for f in hits]
 
@@ -190,7 +273,7 @@ def test_noqa_suppresses_the_flagged_line(rule_id):
     lines = _src(source).splitlines()
     lines[line - 1] += f"  # repic: noqa[{rule_id}]"
     findings = analyze_source(
-        "\n".join(lines) + "\n", f"{rule_id}_noqa.py"
+        "\n".join(lines) + "\n", f"repic_tpu/{rule_id}_noqa.py"
     )
     assert not [f for f in findings if f.rule == rule_id], findings
 
@@ -201,7 +284,7 @@ def test_blanket_noqa_suppresses(rule_id):
     lines = _src(source).splitlines()
     lines[line - 1] += "  # repic: noqa"
     findings = analyze_source(
-        "\n".join(lines) + "\n", f"{rule_id}_noqa_all.py"
+        "\n".join(lines) + "\n", f"repic_tpu/{rule_id}_noqa_all.py"
     )
     assert not [f for f in findings if f.rule == rule_id], findings
 
@@ -418,3 +501,149 @@ def test_missing_path_is_an_error_not_a_green_gate():
     findings = run_paths(["/no/such/dir/at/all"])
     assert findings and findings[0].rule == "RT000"
     assert findings[0].severity == "error"
+
+
+# -- RT2xx project scoping + extra fixtures ---------------------------
+
+
+@pytest.mark.parametrize("rule_id", ["RT201", "RT202", "RT203", "RT204"])
+def test_rt2xx_apply_only_inside_the_package(rule_id):
+    # bench scripts / examples are consumers of the runtime, not the
+    # runtime: the project-contract rules must not fire there
+    source, _, _ = CASES[rule_id]
+    findings = analyze_source(_src(source), "bench_foo.py")
+    assert not [f for f in findings if f.rule == rule_id], findings
+
+
+def test_rt201_exempts_runtime_atomic_itself():
+    src = _src(
+        """
+        def helper(path, mode):
+            return open(path, "wt")
+        """
+    )
+    findings = analyze_source(src, "repic_tpu/runtime/atomic.py")
+    assert not [f for f in findings if f.rule == "RT201"]
+
+
+def test_rt202_start_run_without_finally_fires():
+    src = _src(
+        """
+        from repic_tpu import telemetry
+
+        def run(out_dir):
+            rt = telemetry.start_run(out_dir)
+            do_work()
+            telemetry.finish_run(rt)
+        """
+    )
+    hits = [
+        f
+        for f in analyze_source(src, "repic_tpu/x.py")
+        if f.rule == "RT202"
+    ]
+    assert hits and hits[0].line == 4
+
+
+def test_rt202_start_run_with_finally_is_clean():
+    src = _src(
+        """
+        from repic_tpu import telemetry
+
+        def run(out_dir):
+            rt = telemetry.start_run(out_dir)
+            try:
+                do_work()
+            finally:
+                telemetry.finish_run(rt)
+        """
+    )
+    assert not [
+        f
+        for f in analyze_source(src, "repic_tpu/x.py")
+        if f.rule == "RT202"
+    ]
+
+
+def test_rt203_variable_status_is_not_guessed():
+    # only literal statuses are checkable dataflow-locally; a
+    # variable status is the caller's responsibility
+    src = _src(
+        """
+        def finish(journal, name, status):
+            journal.record(name, status)
+        """
+    )
+    assert not [
+        f
+        for f in analyze_source(src, "repic_tpu/x.py")
+        if f.rule == "RT203"
+    ]
+
+
+# -- decorator-line noqa (engine regression) --------------------------
+
+
+class _DefAnchored(Rule):
+    """Test-only rule anchoring one finding at every decorated def
+    line — the anchor the semantic checker uses for RT101/RT105."""
+
+    rule_id = "RT998"
+    severity = "error"
+    title = "def-anchored test rule"
+    hint = ""
+
+    def check(self, ctx):
+        return [
+            self.finding(ctx, node, f"def {node.name} flagged")
+            for node in ast.walk(ctx.tree)
+            if isinstance(node, ast.FunctionDef)
+            and node.decorator_list
+        ]
+
+
+_DECORATED = """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def f(x, n):
+    return x * n
+"""
+
+
+def test_decorator_noqa_suppresses_def_line_finding():
+    # the finding anchors at the `def` (line 5); the noqa sits on the
+    # decorator line above it (line 4) — the decorator is what the
+    # finding is about, so the suppression must carry down
+    lines = _src(_DECORATED).splitlines()
+    assert lines[3].startswith("@")
+    lines[3] += "  # repic: noqa[RT998]"
+    findings = analyze_source(
+        "\n".join(lines) + "\n",
+        "repic_tpu/deco.py",
+        rules=[_DefAnchored],
+    )
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_decorator_noqa_for_other_rule_does_not_suppress():
+    lines = _src(_DECORATED).splitlines()
+    lines[3] += "  # repic: noqa[RT001]"
+    findings = analyze_source(
+        "\n".join(lines) + "\n",
+        "repic_tpu/deco.py",
+        rules=[_DefAnchored],
+    )
+    assert [f for f in findings if f.rule == "RT998"]
+
+
+def test_decorator_blanket_noqa_suppresses_def_line_finding():
+    lines = _src(_DECORATED).splitlines()
+    lines[3] += "  # repic: noqa"
+    findings = analyze_source(
+        "\n".join(lines) + "\n",
+        "repic_tpu/deco.py",
+        rules=[_DefAnchored],
+    )
+    assert findings == []

@@ -41,6 +41,7 @@ def test_list_rules_covers_the_pack():
     assert proc.returncode == 0, proc.stderr[-2000:]
     for rule_id in (
         "RT001", "RT002", "RT003", "RT004", "RT005", "RT006",
+        "RT201", "RT202", "RT203", "RT204",
     ):
         assert rule_id in proc.stdout, rule_id
 
@@ -53,6 +54,49 @@ def test_json_format_on_clean_tree(tmp_path):
     )
     assert proc.returncode == 0, proc.stdout
     assert json.loads(proc.stdout) == []
+
+
+def test_json_format_carries_machine_readable_fields(tmp_path):
+    # CI annotations and the telemetry report consume this shape:
+    # every finding must carry rule/severity/message/hint/path/line
+    dirty = tmp_path / "repic_tpu"
+    dirty.mkdir()
+    bad = dirty / "dirty.py"
+    bad.write_text(
+        "import jax\n"
+        "\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n"
+    )
+    proc = _run(
+        ["-m", "repic_tpu.analysis", str(bad), "--format", "json"]
+    )
+    assert proc.returncode == 1, proc.stdout
+    findings = json.loads(proc.stdout)
+    assert findings, "expected an RT002 finding"
+    f = findings[0]
+    assert f["rule"] == "RT002"
+    assert f["severity"] == "error"
+    assert f["path"] == str(bad) and f["line"] == 5
+    assert f["message"] and f["hint"]
+    assert set(f) == {
+        "rule", "severity", "message", "hint", "path", "line", "col",
+    }
+
+
+def test_check_help_exits_zero():
+    proc = _run(["-m", "repic_tpu.main", "check", "--help"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "RT101" in proc.stdout  # rule IDs documented in --help
+
+
+def test_lint_help_documents_deep_mode():
+    proc = _run(["-m", "repic_tpu.main", "lint", "--help"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "--deep" in proc.stdout
 
 
 def test_unknown_select_is_a_usage_error():
