@@ -131,6 +131,38 @@ def add_arguments(parser):
         "'auto' stripes only when it pays: fewer micrographs than "
         "devices AND dense fields",
     )
+    parser.add_argument(
+        "--coordination-dir",
+        metavar="DIR",
+        help="enable cluster mode: coordinate N hosts sharing this "
+        "directory (heartbeats, micrograph leases, fences) and the "
+        "same out_dir.  Each host processes a deterministic shard, "
+        "journals to its own _journal.<host>.jsonl, and takes over "
+        "work orphaned by hosts whose heartbeat exceeds "
+        "--host-timeout.  Host identity comes from REPIC_TPU_HOST_ID/"
+        "REPIC_TPU_HOST_RANK/REPIC_TPU_NUM_HOSTS or an active "
+        "jax.distributed runtime.  Implies --resume semantics "
+        "(out_dir is shared and never deleted).  Pass the out_dir "
+        "itself to keep coordination files next to the journals",
+    )
+    parser.add_argument(
+        "--heartbeat-interval",
+        type=float,
+        default=None,
+        metavar="S",
+        help="cluster heartbeat renewal period in seconds "
+        "(default 2.0; requires --coordination-dir)",
+    )
+    parser.add_argument(
+        "--host-timeout",
+        type=float,
+        default=None,
+        metavar="S",
+        help="seconds without a heartbeat before a host is marked "
+        "suspect, fenced, and its unfinished micrographs reassigned "
+        "(default 10.0; requires --coordination-dir).  --strict "
+        "fails fast on the first suspect host instead",
+    )
 
 
 def main(args):
@@ -143,6 +175,26 @@ def main(args):
             "repic-tpu consensus: error: --solver_budget requires "
             "--solver exact (the device greedy/lp packers take no "
             "budget)"
+        )
+    cluster = None
+    if args.coordination_dir:
+        from repic_tpu.runtime.cluster import ClusterConfig
+
+        kwargs = {}
+        if args.heartbeat_interval is not None:
+            kwargs["heartbeat_interval_s"] = args.heartbeat_interval
+        if args.host_timeout is not None:
+            kwargs["host_timeout_s"] = args.host_timeout
+        cluster = ClusterConfig(
+            coordination_dir=args.coordination_dir, **kwargs
+        )
+    elif (
+        args.heartbeat_interval is not None
+        or args.host_timeout is not None
+    ):
+        raise SystemExit(
+            "repic-tpu consensus: error: --heartbeat-interval/"
+            "--host-timeout require --coordination-dir (cluster mode)"
         )
     spatial = {"auto": None, "on": True, "off": False}[args.spatial]
     policy = (
@@ -169,6 +221,7 @@ def main(args):
             strict=args.strict,
             retry_policy=policy,
             solver_budget_s=args.solver_budget,
+            cluster=cluster,
         )
     print(json.dumps(stats, default=str, indent=2))
 

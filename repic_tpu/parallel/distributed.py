@@ -141,6 +141,33 @@ def initialize(
     return True
 
 
+def runtime_identity() -> "tuple[str, int, int] | None":
+    """``(host_id, rank, num_hosts)`` from an ACTIVE ``jax.distributed``
+    runtime, or ``None`` when single-process / uninitialized.
+
+    The cluster runtime (:mod:`repic_tpu.runtime.cluster`) defaults
+    host identity from here, so a pod launch that already initialized
+    the distributed runtime gets consistent host ids in heartbeats,
+    leases, and per-host journals without extra flags.  Inspects the
+    same private client state as :func:`initialize` — and like it,
+    never initializes an XLA backend as a side effect on the
+    single-process path.
+    """
+    try:
+        from jax._src import distributed as _jax_distributed
+
+        if getattr(_jax_distributed.global_state, "client", None) is None:
+            return None
+        import jax
+
+        rank = int(jax.process_index())
+        return (f"proc{rank}", rank, int(jax.process_count()))
+    except Exception:
+        # private-module drift or a backend that refuses process
+        # queries: identity falls back to env vars / single-host
+        return None
+
+
 def shard_for_process(items, process_id=None, process_count=None):
     """This process's contiguous share of a global work list.
 

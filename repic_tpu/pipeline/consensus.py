@@ -420,6 +420,18 @@ def _load_persisted_configs():
     In-process records win over persisted ones (they are fresher).
     Corrupt or unreadable sidecars are ignored — the cache is an
     optimization, never a correctness dependency.
+
+    ``_CONFIG_CACHE_LOADED`` is a once-per-process latch: it is set on
+    the FIRST call even when the cache is disabled via env
+    (``REPIC_TPU_NO_CACHE`` / ``REPIC_TPU_NO_CONFIG_CACHE``) or the
+    sidecar is unreadable, so a process that later re-enables the
+    cache (tests toggling the env var, long-lived notebooks) will NOT
+    load the sidecar unless it resets the flag, and entries written
+    by sibling processes mid-run are never re-read.  That is the
+    intended trade (one stat per process, and the escalation loop
+    corrects any stale/missing config anyway); tests that need
+    isolation reset the flag in their fixture
+    (tests/test_config_cache.py ``clean_config_state``).
     """
     global _CONFIG_CACHE_LOADED
     if _CONFIG_CACHE_LOADED:
@@ -459,6 +471,13 @@ def _persist_config(cfg_key, cfg) -> None:
     dozens of times.  Best-effort like the compile cache: ANY failure
     (corrupt sidecar of the wrong JSON shape included) is swallowed —
     persistence must never take down a computed result.
+
+    The whole read-merge-replace cycle runs under
+    :func:`repic_tpu.runtime.atomic.file_lock`: the atomic replace
+    alone prevents torn files but not lost updates — two concurrent
+    processes (the TPU watcher's bench plus a manual CLI run) could
+    each read, merge, and replace, silently dropping the other's
+    just-written entries (ADVICE.md round 5).
     """
     if _LAST_PERSISTED.get(cfg_key) == tuple(cfg):
         return
@@ -467,32 +486,35 @@ def _persist_config(cfg_key, cfg) -> None:
         return
     import json
 
+    from repic_tpu.runtime.atomic import file_lock
+
     try:
-        entries = []
-        try:
-            with open(path) as f:
-                loaded = json.load(f)
-            if isinstance(loaded, list):
-                entries = [
-                    e for e in loaded
-                    if isinstance(e, dict) and "key" in e
-                ]
-        except (OSError, ValueError):
-            pass
-        ser_key = [
-            list(cfg_key[0]),
-            list(cfg_key[1]),
-            cfg_key[2],
-            cfg_key[3],
-        ]
-        entries = [e for e in entries if e.get("key") != ser_key]
-        entries.append({"key": ser_key, "cfg": list(cfg)})
-        del entries[:-64]
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + f".tmp{os.getpid()}"
-        with open(tmp, "wt") as f:
-            json.dump(entries, f)
-        os.replace(tmp, path)
+        with file_lock(path):
+            entries = []
+            try:
+                with open(path) as f:
+                    loaded = json.load(f)
+                if isinstance(loaded, list):
+                    entries = [
+                        e for e in loaded
+                        if isinstance(e, dict) and "key" in e
+                    ]
+            except (OSError, ValueError):
+                pass
+            ser_key = [
+                list(cfg_key[0]),
+                list(cfg_key[1]),
+                cfg_key[2],
+                cfg_key[3],
+            ]
+            entries = [e for e in entries if e.get("key") != ser_key]
+            entries.append({"key": ser_key, "cfg": list(cfg)})
+            del entries[:-64]
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "wt") as f:
+                json.dump(entries, f)
+            os.replace(tmp, path)
         _LAST_PERSISTED[cfg_key] = tuple(cfg)
     except (OSError, ValueError, TypeError):
         pass
@@ -1215,6 +1237,7 @@ def run_consensus_dir(
     strict: bool = False,
     retry_policy: "RetryPolicy | None" = None,
     solver_budget_s: float | None = None,
+    cluster: "ClusterConfig | None" = None,
 ) -> dict:
     """End-to-end: read picker BOX dirs, consensus, write BOX files.
 
@@ -1252,6 +1275,17 @@ def run_consensus_dir(
     host-side with the in-framework branch-and-bound; under
     ``solver_budget_s`` it degrades exact -> LP-rounding -> greedy
     per micrograph, recording the degradation in the journal.
+
+    Cluster mode (``cluster=ClusterConfig(...)``, docs/robustness.md
+    "Cluster mode"): N hosts point at the SAME ``out_dir`` (and a
+    shared coordination directory).  Each host heartbeats, leases a
+    deterministic shard of the todo list, journals to its own
+    ``_journal.<host>.jsonl``, and — after finishing its shard —
+    fences and takes over work orphaned by hosts whose heartbeat
+    exceeded the timeout.  Cluster mode implies resume semantics
+    (``out_dir`` is shared, so it is never deleted; a manifest
+    mismatch raises instead of restarting) and composes with the
+    batched path only (not ``stripes``).
     """
     import shutil
 
@@ -1291,6 +1325,16 @@ def run_consensus_dir(
                 "dense XLA kernels",
                 stacklevel=2,
             )
+    cluster_ctx = None
+    if cluster is not None:
+        if stripes is not None:
+            raise ValueError(
+                "cluster mode composes with the batched path only "
+                "(not --stripes)"
+            )
+        # A shared out_dir is never deleted under live peers: cluster
+        # mode always resumes (first host in creates the manifest).
+        resume = True
     policy = retry_policy or DEFAULT_POLICY
 
     timer = StageTimer()
@@ -1321,15 +1365,26 @@ def run_consensus_dir(
         "pickers": pickers,
         "names": names,
     }
-    journal = RunJournal.open(out_dir, run_config, resume=resume)
-    if resume and not journal.resumed:
-        # --resume found a DIFFERENT run (or none) in out_dir: the
-        # restart must be from scratch for real — stale outputs of
-        # the other run must not survive next to this one's.
-        journal.close()
-        shutil.rmtree(out_dir)
-        os.makedirs(out_dir, exist_ok=True)
-        journal = RunJournal.open(out_dir, run_config)
+    if cluster is not None:
+        from repic_tpu.runtime.cluster import ClusterContext
+
+        cluster_ctx = ClusterContext(cluster, out_dir)
+        # per-host journal + merged-view resume; a manifest mismatch
+        # raises ManifestMismatch (shared dir — restart is not safe)
+        journal = RunJournal.open(
+            out_dir, run_config, host=cluster_ctx.host, cluster=True
+        )
+        cluster_ctx.start()
+    else:
+        journal = RunJournal.open(out_dir, run_config, resume=resume)
+        if resume and not journal.resumed:
+            # --resume found a DIFFERENT run (or none) in out_dir: the
+            # restart must be from scratch for real — stale outputs of
+            # the other run must not survive next to this one's.
+            journal.close()
+            shutil.rmtree(out_dir)
+            os.makedirs(out_dir, exist_ok=True)
+            journal = RunJournal.open(out_dir, run_config)
     # Telemetry run scope (docs/observability.md): the event log lives
     # next to the journal; the metric sinks are written at each exit.
     run_tlm = telemetry.start_run(out_dir)
@@ -1342,7 +1397,17 @@ def run_consensus_dir(
                 out_name = latest[nm].get("out", nm + out_ext)
                 if os.path.exists(os.path.join(out_dir, out_name)):
                     already_done.add(nm)
-        todo_names = [n for n in names if n not in already_done]
+        if cluster_ctx is not None:
+            # lease this host's deterministic shard of the FULL name
+            # list (a done-filtered list would shift the partition
+            # boundaries between staggered hosts); dead peers'
+            # unfinished names flow back into the partition
+            todo_names = cluster_ctx.plan_shard(
+                names, journal, done=already_done, strict=strict
+            )
+            cluster_ctx.crash_point("start")
+        else:
+            todo_names = [n for n in names if n not in already_done]
 
         # Parallel host-side parse: at the 1024-micrograph scale
         # (BASELINE configs[4]) the sequential loop is the bottleneck,
@@ -1361,29 +1426,41 @@ def run_consensus_dir(
                 return e
 
         workers = min(32, max(4, os.cpu_count() or 4))
-        with tlm_events.span("load", micrographs=len(todo_names)):
-            if len(todo_names) > 1:
-                with ThreadPoolExecutor(max_workers=workers) as ex:
-                    all_sets = list(ex.map(_load_one, todo_names))
-            else:
-                all_sets = [_load_one(nm) for nm in todo_names]
-        loaded, skipped, quarantined = [], [], {}
-        for name, sets in zip(todo_names, all_sets):
-            if isinstance(sets, BaseException):
-                info = error_info(
-                    sets, path=getattr(sets, "path", None),
-                    kind=classify_error(sets),
-                )
-                quarantined[name] = info
-                journal.record(
-                    name, "quarantined", error=info, stage="load"
-                )
-            elif sets is None:
-                skipped.append(name)
-                box_io.write_empty_box(os.path.join(out_dir, name + ".box"))
-                journal.record(name, "skipped", out=name + ".box")
-            else:
-                loaded.append((name, sets))
+
+        def _load_many(nms):
+            with tlm_events.span("load", micrographs=len(nms)):
+                if len(nms) > 1:
+                    with ThreadPoolExecutor(max_workers=workers) as ex:
+                        return list(ex.map(_load_one, nms))
+                return [_load_one(nm) for nm in nms]
+
+        skipped, quarantined = [], {}
+
+        def _partition_loaded(nms, all_sets):
+            """Split load results into processable (name, sets) pairs,
+            journaling quarantines and empty-input skips."""
+            out = []
+            for name, sets in zip(nms, all_sets):
+                if isinstance(sets, BaseException):
+                    info = error_info(
+                        sets, path=getattr(sets, "path", None),
+                        kind=classify_error(sets),
+                    )
+                    quarantined[name] = info
+                    journal.record(
+                        name, "quarantined", error=info, stage="load"
+                    )
+                elif sets is None:
+                    skipped.append(name)
+                    box_io.write_empty_box(
+                        os.path.join(out_dir, name + ".box")
+                    )
+                    journal.record(name, "skipped", out=name + ".box")
+                else:
+                    out.append((name, sets))
+            return out
+
+        loaded = _partition_loaded(todo_names, _load_many(todo_names))
 
         stats = {
             "pickers": pickers,
@@ -1395,10 +1472,13 @@ def run_consensus_dir(
             "num_cliques": 0,
             "particle_counts": {},
         }
-        if not loaded:
+        if not loaded and cluster_ctx is None:
             stats["journal"] = journal.summary()
             journal.close()
             return stats
+        # cluster mode continues even with an empty own shard: the
+        # orphan-harvest loop below may still pick up a dead peer's
+        # work (e.g. a resume generation smaller than the crash set)
 
         timer.stages.append(("load", time.time() - t0))
         n_dev = len(jax.devices()) if use_mesh else 1
@@ -1511,81 +1591,125 @@ def run_consensus_dir(
         num_cliques = 0
         parts = []
         outcomes = ChunkOutcomes()
+        if cluster_ctx is not None:
+            # resume-generation takeovers recorded at plan_shard time
+            outcomes.reassigned.update(cluster_ctx.reassigned)
         # The exact solver runs host-side on the fetched result, so it
         # shares the tables data path; the device program keeps the cheap
         # greedy pack (its picks are recomputed on the host ladder).
         want_fetch = want_tables or host_solver
         device_solver = "greedy" if host_solver else solver
-        for part, cbatch, res, extra, chunk_s in iter_consensus_chunks(
-            loaded,
-            box_size,
-            n_dev=n_dev,
-            threshold=threshold,
-            max_neighbors=max_neighbors,
-            use_mesh=use_mesh,
-            spatial=spatial,
-            solver=device_solver,
-            use_pallas=use_pallas,
-            extra_device_outputs=(
-                None
-                if cc_fn is None
-                else lambda b: cc_fn(jnp.asarray(b.xy), jnp.asarray(b.mask))
-            ),
-            fetch=want_fetch,
-            # plain BOX output: one packed transfer per chunk carries the
-            # escalation probes AND everything the writer needs
-            packed=not want_fetch,
-            strict=strict,
-            policy=policy,
-            outcomes=outcomes,
-            journal=journal,
-        ):
-            parts.append(len(part))
-            compute_s += chunk_s
-            if host_solver:
-                t_solve = time.time()
-                with tlm_events.span("host_solve", micrographs=len(part)):
-                    res = _host_solve_chunk(
-                        part, res, cbatch.capacity,
-                        budget_s=solver_budget_s,
-                        outcomes=outcomes,
-                        strict=strict,
+
+        def _process(pending):
+            """One pass of the chunked pipeline over a work list (the
+            own shard first; cluster orphan batches after)."""
+            nonlocal compute_s, write_s, num_cliques
+            for part, cbatch, res, extra, chunk_s in iter_consensus_chunks(
+                pending,
+                box_size,
+                n_dev=n_dev,
+                threshold=threshold,
+                max_neighbors=max_neighbors,
+                use_mesh=use_mesh,
+                spatial=spatial,
+                solver=device_solver,
+                use_pallas=use_pallas,
+                extra_device_outputs=(
+                    None
+                    if cc_fn is None
+                    else lambda b: cc_fn(
+                        jnp.asarray(b.xy), jnp.asarray(b.mask)
                     )
-                compute_s += time.time() - t_solve
-            t2 = time.time()
-            with tlm_events.span("write", micrographs=len(part)):
-                if want_fetch:
-                    counts.update(
-                        write_consensus_tables(
-                            part, res, extra, out_dir, box_size, pickers,
-                            multi_out=multi_out,
-                            get_cc=get_cc,
-                            num_particles=num_particles,
+                ),
+                fetch=want_fetch,
+                # plain BOX output: one packed transfer per chunk
+                # carries the escalation probes AND everything the
+                # writer needs
+                packed=not want_fetch,
+                strict=strict,
+                policy=policy,
+                outcomes=outcomes,
+                journal=journal,
+            ):
+                parts.append(len(part))
+                compute_s += chunk_s
+                if host_solver:
+                    t_solve = time.time()
+                    with tlm_events.span(
+                        "host_solve", micrographs=len(part)
+                    ):
+                        res = _host_solve_chunk(
+                            part, res, cbatch.capacity,
+                            budget_s=solver_budget_s,
+                            outcomes=outcomes,
+                            strict=strict,
                         )
+                    compute_s += time.time() - t_solve
+                t2 = time.time()
+                with tlm_events.span("write", micrographs=len(part)):
+                    if want_fetch:
+                        counts.update(
+                            write_consensus_tables(
+                                part, res, extra, out_dir, box_size,
+                                pickers,
+                                multi_out=multi_out,
+                                get_cc=get_cc,
+                                num_particles=num_particles,
+                            )
+                        )
+                        num_cliques += int(
+                            np.sum(np.asarray(res.num_cliques))
+                        )
+                    else:
+                        chunk_counts, chunk_nc = write_consensus_boxes(
+                            cbatch, res, out_dir, box_size,
+                            num_particles=num_particles,
+                            with_num_cliques=True,
+                            # zero extra transfers
+                            prefetched_packed=extra,
+                        )
+                        counts.update(chunk_counts)
+                        num_cliques += int(chunk_nc.sum())
+                write_s += time.time() - t2
+                _MICROGRAPHS.inc(len(part))
+                for nm, _sets in part:
+                    fields = dict(
+                        wall_s=round(chunk_s / max(len(part), 1), 6),
+                        solver=outcomes.solver.get(nm, solver),
+                        particles=counts.get(nm),
+                        out=nm + out_ext,
                     )
-                    num_cliques += int(
-                        np.sum(np.asarray(res.num_cliques))
+                    src = outcomes.reassigned.get(nm)
+                    if src is not None:
+                        fields["reassigned_from"] = src
+                    journal.record(
+                        nm, outcomes.status.get(nm, "ok"), **fields
                     )
-                else:
-                    chunk_counts, chunk_nc = write_consensus_boxes(
-                        cbatch, res, out_dir, box_size,
-                        num_particles=num_particles,
-                        with_num_cliques=True,
-                        prefetched_packed=extra,  # zero extra transfers
+                if cluster_ctx is not None:
+                    # host_crash fault site + wedged-host exit: a
+                    # fenced host must stop before touching the next
+                    # chunk (its lease now belongs to a survivor)
+                    cluster_ctx.crash_point(
+                        f"after_chunk:{len(parts) - 1}"
                     )
-                    counts.update(chunk_counts)
-                    num_cliques += int(chunk_nc.sum())
-            write_s += time.time() - t2
-            _MICROGRAPHS.inc(len(part))
-            for nm, _sets in part:
-                journal.record(
-                    nm,
-                    outcomes.status.get(nm, "ok"),
-                    wall_s=round(chunk_s / max(len(part), 1), 6),
-                    solver=outcomes.solver.get(nm, solver),
-                    particles=counts.get(nm),
-                    out=nm + out_ext,
-                )
+                    cluster_ctx.ensure_not_fenced()
+
+        if loaded:
+            _process(loaded)
+        # Host ladder, reassignment rung: after draining its own
+        # lease, a cluster host adopts work orphaned by dead peers
+        # (heartbeat timeout -> suspect -> fence -> reassign) until
+        # nothing claimable remains.
+        while cluster_ctx is not None:
+            orphans = cluster_ctx.harvest_orphans(
+                journal, names, strict=strict
+            )
+            if not orphans:
+                break
+            outcomes.reassigned.update(cluster_ctx.reassigned)
+            adopted = _partition_loaded(orphans, _load_many(orphans))
+            if adopted:
+                _process(adopted)
         # ladder-exhausted micrographs quarantined during chunking (the
         # iterator already journaled them as they happened)
         quarantined.update(outcomes.quarantined)
@@ -1599,6 +1723,8 @@ def run_consensus_dir(
             particle_counts=counts,
             num_cliques=num_cliques,
         )
+        if cluster_ctx is not None:
+            stats["cluster"] = cluster_ctx.stats()
         stats["journal"] = journal.summary()
         journal.close()
         if len(parts) > 1:
@@ -1607,7 +1733,11 @@ def run_consensus_dir(
     finally:
         # exception-safe: a --strict raise must still restore
         # the previous event log and write the metric sinks
-        # (idempotent after the normal-path call above)
+        # (idempotent after the normal-path call above); a cluster
+        # host records a clean stop so peers reassign without a
+        # timeout wait
+        if cluster_ctx is not None:
+            cluster_ctx.stop()
         telemetry.finish_run(run_tlm)
 
 

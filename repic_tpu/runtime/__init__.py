@@ -8,37 +8,57 @@ gracefully under budget pressure, and proves all of it with a
 deterministic fault-injection harness:
 
 * :mod:`repic_tpu.runtime.journal` — JSONL run journal + manifest,
-  the ``--resume`` substrate;
+  the ``--resume`` substrate (per-host journals with merge-on-read
+  in cluster mode);
 * :mod:`repic_tpu.runtime.ladder` — retry/degradation policy (chunk
-  ladder + solver ladder exact -> lp -> greedy);
+  ladder + solver ladder exact -> lp -> greedy + the host liveness
+  rung);
+* :mod:`repic_tpu.runtime.cluster` — multi-host fault tolerance:
+  heartbeats, leases, fencing, orphaned-work reassignment
+  (docs/robustness.md "Cluster mode");
 * :mod:`repic_tpu.runtime.faults` — deterministic fault injection
   (``REPIC_TPU_FAULTS`` / :func:`~repic_tpu.runtime.faults.fault_plan`);
-* :mod:`repic_tpu.runtime.atomic` — crash-safe artifact writes.
+* :mod:`repic_tpu.runtime.atomic` — crash-safe artifact writes,
+  advisory file locks, create-once claims.
 
 Everything here is stdlib-only at import time (jax/numpy load lazily
 inside functions), so host-only commands stay free of XLA startup.
 """
 
-from repic_tpu.runtime.atomic import atomic_write
-from repic_tpu.runtime.journal import RunJournal, error_info, read_journal
+from repic_tpu.runtime.atomic import atomic_write, file_lock
+from repic_tpu.runtime.cluster import ClusterConfig, ClusterContext
+from repic_tpu.runtime.journal import (
+    RunJournal,
+    error_info,
+    merged_latest,
+    read_all_journals,
+    read_journal,
+)
 from repic_tpu.runtime.ladder import (
     DEFAULT_POLICY,
     ChunkOutcomes,
     RetryPolicy,
     classify_error,
+    host_rung,
     is_oom_error,
     solve_host_ladder,
 )
 
 __all__ = [
     "atomic_write",
+    "file_lock",
+    "ClusterConfig",
+    "ClusterContext",
     "RunJournal",
     "error_info",
+    "merged_latest",
+    "read_all_journals",
     "read_journal",
     "DEFAULT_POLICY",
     "ChunkOutcomes",
     "RetryPolicy",
     "classify_error",
+    "host_rung",
     "is_oom_error",
     "solve_host_ladder",
 ]

@@ -1,4 +1,4 @@
-"""Crash-safe file writes (tmp + ``os.replace``).
+"""Crash-safe file writes (tmp + ``os.replace``) and advisory locks.
 
 Every artifact writer in the pipeline (BOX files, consensus TSVs,
 runtime tables, the run manifest) goes through :func:`atomic_write`:
@@ -7,6 +7,15 @@ with one atomic ``os.replace``, so an interrupted run never leaves a
 torn half-written output — the reader either sees the previous
 complete file or the new complete file, never a prefix.  This is the
 atomic-write rung of the fault-tolerant runtime (docs/robustness.md).
+
+:func:`file_lock` complements it for *read-merge-replace* cycles on a
+shared file (the capacity-config sidecar, the cluster manifest):
+``os.replace`` prevents torn content but not lost updates — two
+processes that both read, merge, and replace can silently drop each
+other's entries.  An ``flock`` on a ``.lock`` sibling serializes the
+whole cycle.  :func:`try_claim` provides the third primitive: an
+atomic create-once claim (``O_CREAT | O_EXCL``) for records that must
+have exactly one writer ever (cluster fence tokens).
 """
 
 from __future__ import annotations
@@ -40,3 +49,50 @@ def atomic_write(path: str, mode: str = "wt"):
         raise
     f.close()
     os.replace(tmp, path)
+
+
+@contextlib.contextmanager
+def file_lock(path: str):
+    """Advisory exclusive lock serializing read-merge-replace on ``path``.
+
+    Locks a ``path + ".lock"`` sibling (never ``path`` itself — the
+    replace would swap the locked inode out from under a waiter) with
+    ``fcntl.flock``, so concurrent processes each see the previous
+    writer's merge instead of overwriting it.  The lock file is left
+    in place — unlinking it would race a process that just opened it.
+    Degrades to a no-op where ``fcntl`` is unavailable (non-POSIX):
+    the caller keeps atomic-replace safety, merely without the
+    lost-update guarantee.
+    """
+    try:
+        import fcntl
+    except ImportError:  # pragma: no cover - non-POSIX fallback
+        yield
+        return
+    f = open(path + ".lock", "a")
+    try:
+        fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+        yield
+    finally:
+        with contextlib.suppress(OSError):
+            fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+        f.close()
+
+
+def try_claim(path: str, payload: str) -> bool:
+    """Atomically create ``path`` with ``payload``; False if it exists.
+
+    ``O_CREAT | O_EXCL`` makes creation the linearization point: of N
+    concurrent claimants exactly one wins, everyone else observes the
+    existing file.  Used for cluster fence tokens, where two survivors
+    must never both believe they own a dead host's work.
+    """
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        return False
+    with os.fdopen(fd, "w") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    return True

@@ -6,18 +6,33 @@ corrupt inputs are too rare and too nondeterministic to rely on.  This
 module lets tests (and operators, via ``REPIC_TPU_FAULTS``) plant
 failures at named sites in the pipeline:
 
-==============  ====================================================
-site            raised at the matching call site
-==============  ====================================================
-``io``          ``OSError`` — transient I/O failure
-``oom``         ``RuntimeError`` whose text matches the runtime's
-                OOM classifier (``RESOURCE_EXHAUSTED``)
-``corrupt_box`` ``ValueError`` — malformed BOX content (surfaces as
-                :class:`repic_tpu.utils.box_io.BoxParseError`)
+================= ==================================================
+site              raised at the matching call site
+================= ==================================================
+``io``            ``OSError`` — transient I/O failure
+``oom``           ``RuntimeError`` whose text matches the runtime's
+                  OOM classifier (``RESOURCE_EXHAUSTED``)
+``corrupt_box``   ``ValueError`` — malformed BOX content (surfaces
+                  as :class:`repic_tpu.utils.box_io.BoxParseError`)
 ``solver_budget`` no exception — the solver ladder polls
-                :func:`check` and treats a firing as budget
-                exhaustion of that rung
-==============  ====================================================
+                  :func:`check` and treats a firing as budget
+                  exhaustion of that rung
+``host_crash``    no exception — polled by
+                  ``runtime.cluster.ClusterContext.crash_point``,
+                  which terminates the process with
+                  ``os._exit(CRASH_EXIT_CODE)``: an abrupt host
+                  loss (no journal close, no heartbeat stop, no
+                  Python cleanup).  Keys: ``<host>:start``,
+                  ``<host>:after_chunk:<i>``
+``heartbeat_stall`` no exception — polled in the heartbeat renewal
+                  loop; a firing skips that renewal (``inf`` times
+                  wedges the host until the timeout marks it
+                  suspect while the process keeps running)
+``lease_race``    no exception — polled in
+                  ``runtime.cluster.try_claim``; a firing makes the
+                  claim report a lost race (as if a concurrent
+                  host created the record first)
+================= ==================================================
 
 Injection is purely count-based (no randomness, no clocks): a
 :class:`Fault` fires at the first ``times`` call sites whose key
@@ -42,6 +57,18 @@ import threading
 from dataclasses import dataclass, field
 
 _UNLIMITED = ("inf", "*")
+
+#: every site the runtime polls/injects — docs and tests validate
+#: plans against this list (a typo'd site silently never fires)
+KNOWN_SITES = (
+    "io",
+    "oom",
+    "corrupt_box",
+    "solver_budget",
+    "host_crash",
+    "heartbeat_stall",
+    "lease_race",
+)
 
 
 @dataclass

@@ -22,18 +22,67 @@ The journal is append-only and flushed per record, so a crash loses
 at most the in-flight micrograph; outputs themselves are atomic
 (:mod:`repic_tpu.runtime.atomic`), so a recorded completion always
 points at a complete file.
+
+Cluster runs (docs/robustness.md "Cluster mode"): each host appends
+to its OWN ``_journal.<host>.jsonl`` (single-writer files need no
+cross-host locking; a crashed host tears at most its own trailing
+line) and every record carries a ``host`` field.  Readers merge on
+read: :func:`read_all_journals` concatenates every journal file in
+the run directory sorted by timestamp, and :func:`merged_latest`
+folds that into a last-writer-wins per-micrograph view — the view
+``--resume`` and ``repic-tpu report`` trust after a host loss.  The
+shared ``_manifest.json`` is created once under
+:func:`~repic_tpu.runtime.atomic.file_lock`; a config mismatch in
+cluster mode raises :class:`ManifestMismatch` instead of restarting,
+because deleting a shared run directory under live peers is never
+safe.
 """
 
 from __future__ import annotations
 
+import glob
 import json
 import os
+import re
 import time
 
-from repic_tpu.runtime.atomic import atomic_write
+from repic_tpu.runtime.atomic import atomic_write, file_lock
 
 JOURNAL_NAME = "_journal.jsonl"
 MANIFEST_NAME = "_manifest.json"
+
+
+def sanitize_host_id(host: str) -> str:
+    """Host ids become file-name components (journals, heartbeats,
+    leases, fences) — restrict the alphabet in ONE place so the id
+    recorded inside entries and the id embedded in file names can
+    never diverge."""
+    safe = re.sub(r"[^A-Za-z0-9._-]", "_", str(host))
+    if not safe:
+        raise ValueError(f"empty host id after sanitizing {host!r}")
+    return safe
+
+
+def host_journal_name(host: str) -> str:
+    """Per-host journal file name (cluster runs)."""
+    return f"_journal.{sanitize_host_id(host)}.jsonl"
+
+
+def journal_paths(out_dir: str) -> list[str]:
+    """Every journal file of a run: the single-process ``_journal.jsonl``
+    plus any per-host ``_journal.<host>.jsonl``, in sorted order."""
+    paths = []
+    base = os.path.join(out_dir, JOURNAL_NAME)
+    if os.path.exists(base):
+        paths.append(base)
+    paths.extend(
+        sorted(glob.glob(os.path.join(out_dir, "_journal.*.jsonl")))
+    )
+    return paths
+
+
+class ManifestMismatch(ValueError):
+    """Cluster-mode open found a manifest pinning a DIFFERENT run."""
 
 STATUS_OK = "ok"
 STATUS_RETRIED = "retried"        # succeeded after >= 1 retry
@@ -55,9 +104,13 @@ def error_info(exc: BaseException, **extra) -> dict:
 class RunJournal:
     """Append-only JSONL journal with a config-pinning manifest."""
 
-    def __init__(self, out_dir: str):
+    def __init__(self, out_dir: str, host: str | None = None):
         self.out_dir = out_dir
-        self.path = os.path.join(out_dir, JOURNAL_NAME)
+        self.host = host
+        self.path = os.path.join(
+            out_dir,
+            host_journal_name(host) if host else JOURNAL_NAME,
+        )
         self.manifest_path = os.path.join(out_dir, MANIFEST_NAME)
         self.resumed = False
         self._latest: dict[str, dict] = {}
@@ -67,16 +120,52 @@ class RunJournal:
     # -- lifecycle ----------------------------------------------------
 
     @classmethod
-    def open(cls, out_dir: str, config: dict, *, resume: bool = False):
+    def open(
+        cls,
+        out_dir: str,
+        config: dict,
+        *,
+        resume: bool = False,
+        host: str | None = None,
+        cluster: bool = False,
+    ):
         """Open (or resume) the journal for a run configuration.
 
         ``config`` must be JSON-serializable; it is round-tripped
         through JSON before comparison so tuple-vs-list never causes
         a spurious mismatch.
+
+        With ``cluster=True`` (requires ``host``) the journal appends
+        to this host's ``_journal.<host>.jsonl`` while ``latest()`` /
+        ``done_names()`` reflect the MERGED view over every host's
+        journal; the manifest is created once under a file lock and a
+        mismatch raises :class:`ManifestMismatch` (never a restart —
+        the directory is shared with live peers).
         """
-        j = cls(out_dir)
+        if cluster and not host:
+            raise ValueError("cluster journals require a host id")
+        j = cls(out_dir, host=host)
         config = json.loads(json.dumps(config))
         os.makedirs(out_dir, exist_ok=True)
+        if cluster:
+            with file_lock(j.manifest_path):
+                prev = j._read_manifest()
+                if prev is None:
+                    with atomic_write(j.manifest_path) as f:
+                        json.dump(
+                            {"config": config, "created": time.time()},
+                            f, indent=2,
+                        )
+                elif prev.get("config") != config:
+                    raise ManifestMismatch(
+                        f"manifest in {out_dir} pins a different run "
+                        "configuration; cluster mode never restarts a "
+                        "shared directory — point the run elsewhere "
+                        "or fix the flags"
+                    )
+            j._load_merged()
+            j.resumed = bool(j._latest or j._events)
+            return j
         prev = j._read_manifest()
         if resume and prev is not None and prev.get("config") == config:
             j.resumed = True
@@ -104,6 +193,8 @@ class RunJournal:
     def record(self, name: str, status: str, **fields) -> dict:
         """Append one micrograph outcome (flushed immediately)."""
         entry = {"name": name, "status": status, "ts": time.time()}
+        if self.host:
+            entry["host"] = self.host
         entry.update(fields)
         self._append(entry)
         self._latest[name] = entry
@@ -112,6 +203,8 @@ class RunJournal:
     def record_event(self, event: str, **fields) -> dict:
         """Append a run-level event (chunk retry, chunk halving, ...)."""
         entry = {"event": event, "ts": time.time()}
+        if self.host:
+            entry["host"] = self.host
         entry.update(fields)
         self._append(entry)
         self._events.append(entry)
@@ -165,24 +258,20 @@ class RunJournal:
             return None
 
     def _load_entries(self) -> None:
-        try:
-            with open(self.path) as f:
-                lines = f.readlines()
-        except OSError:
-            return
-        for line in lines:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                entry = json.loads(line)
-            except ValueError:
-                continue  # torn trailing line from a crash
+        for entry in _read_entries(self.path):
             if "name" in entry:
                 self._latest[entry["name"]] = entry
             elif "event" in entry:
                 self._events.append(entry)
 
+    def _load_merged(self) -> None:
+        """Cluster resume: fold EVERY host's journal (timestamp order,
+        last writer wins) into the latest-per-micrograph view."""
+        for entry in read_all_journals(self.out_dir):
+            if "name" in entry:
+                self._latest[entry["name"]] = entry
+            elif "event" in entry:
+                self._events.append(entry)
 
 def read_journal(out_dir: str) -> list[dict]:
     """All journal entries of a run (test/inspection/report helper).
@@ -191,17 +280,85 @@ def read_journal(out_dir: str) -> list[dict]:
     ``_load_entries`` does: a crash mid-append is exactly the run a
     post-mortem ``repic-tpu report`` is pointed at.
     """
-    path = os.path.join(out_dir, JOURNAL_NAME)
-    entries = []
-    if not os.path.exists(path):
-        return entries  # no entries recorded (or journal discarded)
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                entries.append(json.loads(line))
-            except ValueError:
-                continue  # torn trailing line from a crash
+    return _read_entries(os.path.join(out_dir, JOURNAL_NAME))
+
+
+def _read_entries(path: str) -> list[dict]:
+    """One journal file's entries, tolerating the torn trailing line
+    a crash mid-append leaves behind."""
+    entries: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entries.append(json.loads(line))
+                except ValueError:
+                    continue  # torn trailing line from a crash
+    except OSError:
+        pass  # deleted between glob and open
     return entries
+
+
+def read_all_journals(out_dir: str) -> list[dict]:
+    """Merge-on-read over every journal file of a run.
+
+    Entries from the single-process journal AND all per-host journals,
+    stable-sorted by timestamp so folding them front-to-back yields
+    last-writer-wins semantics for micrographs recorded by more than
+    one host (a reassignment after a false-positive suspicion, two
+    generations of a resumed run).  Each file tolerates a torn
+    trailing line — a crashed host's journal is exactly the file the
+    merge exists to read.
+    """
+    entries: list[dict] = []
+    for path in journal_paths(out_dir):
+        entries.extend(_read_entries(path))
+    entries.sort(key=lambda e: float(e.get("ts", 0.0)))
+    return entries
+
+
+def merged_latest(out_dir: str) -> dict[str, dict]:
+    """Latest entry per micrograph over ALL hosts' journals."""
+    latest: dict[str, dict] = {}
+    for entry in read_all_journals(out_dir):
+        if "name" in entry:
+            latest[entry["name"]] = entry
+    return latest
+
+
+class MergedJournalReader:
+    """Incremental :func:`merged_latest` for pollers.
+
+    The cluster orphan harvest re-reads the merged view every few
+    hundred milliseconds while waiting out a heartbeat timeout; on a
+    large run that is megabytes of repeated JSON parsing (worse over
+    NFS).  This reader re-parses only the files whose size changed
+    since the previous call — journals are append-only, so size is a
+    sufficient change signal — and re-sorts the (cheap) concatenation.
+    """
+
+    def __init__(self, out_dir: str):
+        self.out_dir = out_dir
+        self._cache: dict[str, tuple[int, list[dict]]] = {}
+
+    def latest(self) -> dict[str, dict]:
+        entries: list[dict] = []
+        for path in journal_paths(self.out_dir):
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                self._cache.pop(path, None)
+                continue
+            cached = self._cache.get(path)
+            if cached is None or cached[0] != size:
+                self._cache[path] = (size, _read_entries(path))
+            entries.extend(self._cache[path][1])
+        entries.sort(key=lambda e: float(e.get("ts", 0.0)))
+        latest: dict[str, dict] = {}
+        for entry in entries:
+            if "name" in entry:
+                latest[entry["name"]] = entry
+        return latest

@@ -19,9 +19,19 @@ scattered failure handling (the ad-hoc OOM halving in
   degradation.  Mirrors budget-pressure degradation in large solver
   stacks (DuaLip-GPU tech report) rather than failing the run.
 
+* **host ladder** (cluster runs, driven by
+  :mod:`repic_tpu.runtime.cluster`): heartbeat-timeout -> mark host
+  *suspect* -> fence its lease -> reassign its incomplete micrographs
+  to a survivor.  :func:`host_rung` is the classification step (pure
+  — age against timeout, with clean-stop and fence overrides);
+  fencing/reassignment mechanics live in ``cluster.py``.  Strict mode
+  fails fast on the first suspect host instead of reassigning, the
+  cluster analog of the per-micrograph strict contract.
+
 Fault-injection hooks (:mod:`repic_tpu.runtime.faults`) cover every
 rung: ``oom``/``io`` fire in the chunk loop, ``solver_budget`` makes
-a named rung report exhaustion.
+a named rung report exhaustion, and ``host_crash`` /
+``heartbeat_stall`` / ``lease_race`` exercise the host ladder.
 """
 
 from __future__ import annotations
@@ -75,6 +85,44 @@ class RetryPolicy:
 DEFAULT_POLICY = RetryPolicy()
 
 
+# -- host ladder (cluster runs) ---------------------------------------
+#
+# A host's liveness rung, judged from its heartbeat record.  Order
+# matters operationally: fenced > stopped > suspect > live — a fence
+# overrides everything (the host has been administratively excluded),
+# a clean stop means its incomplete lease is immediately reassignable
+# (no timeout wait), and only a silent host needs the timeout.
+HOST_LIVE = "live"
+HOST_STOPPED = "stopped"      # clean shutdown recorded; no timeout wait
+HOST_SUSPECT = "suspect"      # heartbeat older than the timeout
+HOST_FENCED = "fenced"        # lease fenced by a survivor
+
+#: rungs whose incomplete lease a survivor may reassign
+REASSIGNABLE_RUNGS = frozenset((HOST_STOPPED, HOST_SUSPECT, HOST_FENCED))
+
+
+def host_rung(
+    age_s: float | None,
+    timeout_s: float,
+    *,
+    stopped: bool = False,
+    fenced: bool = False,
+) -> str:
+    """Classify one host on the cluster ladder.
+
+    ``age_s`` is the heartbeat age (``None`` = no heartbeat record at
+    all, which reads as suspect: a host that never checked in cannot
+    be assumed live).
+    """
+    if fenced:
+        return HOST_FENCED
+    if stopped:
+        return HOST_STOPPED
+    if age_s is None or age_s > timeout_s:
+        return HOST_SUSPECT
+    return HOST_LIVE
+
+
 @dataclass
 class ChunkOutcomes:
     """Per-run ladder bookkeeping, filled by the chunk iterator and
@@ -83,6 +131,7 @@ class ChunkOutcomes:
     status: dict = None       # name -> retried|degraded (default ok)
     quarantined: dict = None  # name -> structured error info
     solver: dict = None       # name -> solver rung that actually ran
+    reassigned: dict = None   # name -> source host (cluster takeover)
 
     def __post_init__(self):
         if self.status is None:
@@ -91,6 +140,8 @@ class ChunkOutcomes:
             self.quarantined = {}
         if self.solver is None:
             self.solver = {}
+        if self.reassigned is None:
+            self.reassigned = {}
 
     def mark(self, names, status: str) -> None:
         """Escalate the recorded status (degraded wins over retried)."""

@@ -81,13 +81,20 @@ def _read_runtime_tsv(run_dir: str) -> dict:
 
 
 def build_report(run_dir: str) -> dict:
-    """Join journal + events + metrics of ``run_dir`` into one dict."""
-    from repic_tpu.runtime.journal import read_journal
+    """Join journal + events + metrics of ``run_dir`` into one dict.
+
+    Cluster runs are merged on read: entries from every
+    ``_journal.<host>.jsonl`` fold in timestamp order (last writer
+    wins per micrograph), and the summary gains a ``cluster`` section
+    with per-host outcome tallies plus suspicion/fence/reassignment
+    counts — what a fleet operator needs after a host loss.
+    """
+    from repic_tpu.runtime.journal import read_all_journals
 
     if not os.path.isdir(run_dir):
         raise FileNotFoundError(f"run directory not found: {run_dir}")
 
-    journal = read_journal(run_dir)
+    journal = read_all_journals(run_dir)
     records = _events.read_events(run_dir)
     metrics = _sinks.read_metrics_json(run_dir)
 
@@ -98,15 +105,41 @@ def build_report(run_dir: str) -> dict:
         "chunk_halvings": 0,
         "per_micrograph_fallbacks": 0,
     }
+    cluster = {
+        "hosts": {},
+        "suspects": 0,
+        "fences": 0,
+        "reassignments": {"events": 0, "micrographs": 0},
+    }
+    clustered = False
+    # distinct hosts, not raw events: with several survivors (or
+    # several generations) the same dead host may be suspected or
+    # fenced more than once, and the operator wants a host count
+    suspect_hosts: set = set()
+    fenced_hosts: set = set()
     for entry in journal:
         if "name" in entry:
             latest[entry["name"]] = entry
+            if "host" in entry:
+                clustered = True
         elif entry.get("event") == "chunk_retry":
             ladder["chunk_retries"] += 1
         elif entry.get("event") == "chunk_halved":
             ladder["chunk_halvings"] += 1
         elif entry.get("event") == "per_micrograph_fallback":
             ladder["per_micrograph_fallbacks"] += 1
+        elif entry.get("event") == "host_suspect":
+            clustered = True
+            suspect_hosts.add(entry.get("suspect"))
+        elif entry.get("event") == "host_fenced":
+            clustered = True
+            fenced_hosts.add(entry.get("suspect"))
+        elif entry.get("event") == "work_reassigned":
+            clustered = True
+            cluster["reassignments"]["events"] += 1
+            cluster["reassignments"]["micrographs"] += int(
+                entry.get("count", len(entry.get("names", ())))
+            )
 
     by_status: dict[str, int] = {}
     solver_rungs: dict[str, int] = {}
@@ -122,6 +155,14 @@ def build_report(run_dir: str) -> dict:
             wall.append(float(e["wall_s"]))
         if isinstance(e.get("particles"), int):
             particles += e["particles"]
+        if clustered:
+            host = e.get("host", "(no host)")
+            hstats = cluster["hosts"].setdefault(
+                host, {"by_status": {}, "reassigned_in": 0}
+            )
+            hstats["by_status"][s] = hstats["by_status"].get(s, 0) + 1
+            if e.get("reassigned_from") is not None:
+                hstats["reassigned_in"] += 1
 
     # -- events: per-stage span latencies + probe deltas -------------
     stage_durs: dict[str, list[float]] = {}
@@ -193,6 +234,11 @@ def build_report(run_dir: str) -> dict:
         "device": device,
         "runtime_tsv": _read_runtime_tsv(run_dir),
     }
+    if clustered:
+        cluster["hosts"] = dict(sorted(cluster["hosts"].items()))
+        cluster["suspects"] = len(suspect_hosts)
+        cluster["fences"] = len(fenced_hosts)
+        report["cluster"] = cluster
     return report
 
 
@@ -236,6 +282,27 @@ def format_report(report: dict) -> str:
         f"{lad['per_micrograph_fallbacks']} "
         f"quarantined={mg['by_status'].get('quarantined', 0)}"
     )
+
+    cl = report.get("cluster")
+    if cl:
+        lines.append("cluster hosts:")
+        for host, hs in cl["hosts"].items():
+            tally = ", ".join(
+                f"{k}={v}" for k, v in sorted(hs["by_status"].items())
+            )
+            extra = (
+                f" (reassigned_in={hs['reassigned_in']})"
+                if hs.get("reassigned_in")
+                else ""
+            )
+            lines.append(f"  {host}: {tally}{extra}")
+        re_ = cl["reassignments"]
+        lines.append(
+            "host ladder: "
+            f"suspects={cl['suspects']} fences={cl['fences']} "
+            f"reassigned={re_['micrographs']} "
+            f"(in {re_['events']} event(s))"
+        )
 
     if report["stages"]:
         lines.append("stage latencies (s):")

@@ -1,0 +1,104 @@
+"""Worker process for the simulated multi-host cluster tests.
+
+Launched 2-3 times by tests/test_cluster_multihost.py, each instance
+an INDEPENDENT single-process JAX CPU runtime (cluster coordination
+is file-based — no ``jax.distributed`` required, per ROADMAP item
+2's "gate with a simulated multi-process CI job").  All workers point
+at the same input and output directories; identity and fault plans
+arrive via the environment:
+
+* ``REPIC_TPU_HOST_ID`` / ``REPIC_TPU_HOST_RANK`` /
+  ``REPIC_TPU_NUM_HOSTS`` — cluster identity;
+* ``REPIC_TPU_FAULTS`` — e.g. ``host_crash:after_chunk:0`` to die
+  abruptly (``os._exit``) after journaling the first chunk, or
+  ``host_crash:start`` to die right after leasing a shard.
+
+``--barrier FILE`` synchronizes worker start: each worker writes
+``<FILE>.ready.<rank>`` once imports are done and spins until FILE
+exists — without it, the multi-second jax import stagger on a 1-core
+CI machine would let fast workers finish before slow ones even lease
+a shard, making crash/takeover timing nondeterministic.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("in_dir")
+    p.add_argument("out_dir")
+    p.add_argument("box_size", type=int)
+    p.add_argument("--heartbeat-interval", type=float, default=0.2)
+    p.add_argument("--host-timeout", type=float, default=1.5)
+    p.add_argument(
+        "--takeover-wait", type=float, default=None,
+        help="seconds a finished worker lingers to adopt orphans "
+        "(default: auto = timeout + 2 renewals; 0 = exit at once)",
+    )
+    p.add_argument("--barrier", default=None)
+    args = p.parse_args()
+
+    # One plain CPU device per worker: scrub the virtual-device flag
+    # inherited from the test conftest and force the CPU platform
+    # (same recipe as tests/distributed_worker.py).
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", flags
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("REPIC_TPU_NO_CACHE", "1")
+    # one micrograph per chunk: fine-grained crash points and journal
+    # records, so a mid-run host loss orphans a nontrivial remainder
+    os.environ.setdefault("REPIC_CONSENSUS_CHUNK", "1")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from repic_tpu.runtime import faults
+
+    faults.install_from_env()
+
+    from repic_tpu.pipeline.consensus import run_consensus_dir
+    from repic_tpu.runtime.cluster import ClusterConfig
+
+    rank = int(os.environ.get("REPIC_TPU_HOST_RANK", "0"))
+    if args.barrier:
+        with open(f"{args.barrier}.ready.{rank}", "w") as f:
+            f.write(str(os.getpid()))
+        deadline = time.time() + 120.0
+        while not os.path.exists(args.barrier):
+            if time.time() > deadline:
+                print("barrier timeout", file=sys.stderr)
+                return 2
+            time.sleep(0.02)
+
+    cfg = ClusterConfig(
+        coordination_dir=args.out_dir,
+        heartbeat_interval_s=args.heartbeat_interval,
+        host_timeout_s=args.host_timeout,
+        takeover_wait_s=args.takeover_wait,
+    )
+    stats = run_consensus_dir(
+        args.in_dir,
+        args.out_dir,
+        args.box_size,
+        use_mesh=False,
+        cluster=cfg,
+    )
+    host = stats["cluster"]["host"]
+    with open(
+        os.path.join(args.out_dir, f"stats.{host}.json"), "w"
+    ) as f:
+        json.dump(stats, f, default=str)
+    print(json.dumps(stats["journal"], default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,640 @@
+"""Unit tests for the cluster runtime: heartbeats, liveness, leases,
+fencing, merge-on-read journals, and the orphan-harvest ladder rung.
+
+Everything here is single-process and jax-free (the coordination
+layer is files + stdlib); the subprocess end-to-end scenarios live in
+tests/test_cluster_multihost.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from repic_tpu.runtime import cluster, faults, journal
+from repic_tpu.runtime.ladder import (
+    HOST_FENCED,
+    HOST_LIVE,
+    HOST_STOPPED,
+    HOST_SUSPECT,
+    host_rung,
+)
+
+
+def _ctx(tmp_path, host="hA", rank=0, num_hosts=1, **kw):
+    kw.setdefault("heartbeat_interval_s", 0.05)
+    kw.setdefault("host_timeout_s", 0.5)
+    cfg = cluster.ClusterConfig(
+        coordination_dir=str(tmp_path),
+        host_id=host,
+        rank=rank,
+        num_hosts=num_hosts,
+        **kw,
+    )
+    return cluster.ClusterContext(cfg, str(tmp_path))
+
+
+def _age_heartbeat(tmp_path, host, age_s):
+    """Backdate a host's heartbeat to simulate silence."""
+    path = cluster.heartbeat_path(str(tmp_path), host)
+    data = json.load(open(path))
+    data["ts"] = time.time() - age_s
+    with open(path, "w") as f:
+        json.dump(data, f)
+
+
+def _journal(tmp_path, host):
+    return journal.RunJournal.open(
+        str(tmp_path), {"cfg": 1}, host=host, cluster=True
+    )
+
+
+# -- host ladder rung -------------------------------------------------
+
+
+def test_host_rung_classification():
+    assert host_rung(0.1, 1.0) == HOST_LIVE
+    assert host_rung(2.0, 1.0) == HOST_SUSPECT
+    assert host_rung(None, 1.0) == HOST_SUSPECT
+    assert host_rung(0.1, 1.0, stopped=True) == HOST_STOPPED
+    # fence overrides everything, even a fresh heartbeat
+    assert host_rung(0.1, 1.0, fenced=True) == HOST_FENCED
+    assert host_rung(99.0, 1.0, stopped=True, fenced=True) == (
+        HOST_FENCED
+    )
+
+
+def test_cluster_config_rejects_timeout_under_interval():
+    with pytest.raises(ValueError, match="exceed"):
+        cluster.ClusterConfig(
+            heartbeat_interval_s=5.0, host_timeout_s=1.0
+        )
+
+
+# -- heartbeats and liveness -----------------------------------------
+
+
+def test_heartbeat_lifecycle(tmp_path):
+    ctx = _ctx(tmp_path)
+    ctx.beat()
+    view = cluster.read_liveness(str(tmp_path), 5.0)
+    assert view["hA"].rung == HOST_LIVE
+    assert view["hA"].seq == 1
+
+    _age_heartbeat(tmp_path, "hA", 10.0)
+    view = cluster.read_liveness(str(tmp_path), 5.0)
+    assert view["hA"].rung == HOST_SUSPECT
+
+    ctx.beat(stopped=True)
+    view = cluster.read_liveness(str(tmp_path), 5.0)
+    assert view["hA"].rung == HOST_STOPPED
+
+
+def test_heartbeat_thread_renews_and_stops_clean(tmp_path):
+    ctx = _ctx(tmp_path)
+    ctx.start()
+    time.sleep(0.2)
+    ctx.stop()
+    data = json.load(
+        open(cluster.heartbeat_path(str(tmp_path), "hA"))
+    )
+    assert data["stopped"] is True
+    assert data["seq"] >= 2  # initial beat + >=1 renewal + stop
+
+
+@pytest.mark.faults
+def test_heartbeat_stall_fault_skips_renewal(tmp_path):
+    ctx = _ctx(tmp_path)
+    ctx.beat()
+    seq0 = json.load(
+        open(cluster.heartbeat_path(str(tmp_path), "hA"))
+    )["seq"]
+    with faults.fault_plan("heartbeat_stall::inf"):
+        ctx.beat()
+        ctx.beat()
+    data = json.load(
+        open(cluster.heartbeat_path(str(tmp_path), "hA"))
+    )
+    assert data["seq"] == seq0  # both renewals swallowed
+    ctx.beat()
+    data = json.load(
+        open(cluster.heartbeat_path(str(tmp_path), "hA"))
+    )
+    assert data["seq"] == seq0 + 1  # plan gone -> renewals resume
+
+
+@pytest.mark.faults
+def test_crash_point_exits_process(tmp_path):
+    """host_crash must kill the process via os._exit (no cleanup) —
+    verified in a subprocess so the suite survives."""
+    code = (
+        "import os\n"
+        "os.environ['REPIC_TPU_FAULTS'] = 'host_crash:boom'\n"
+        "from repic_tpu.runtime import cluster, faults\n"
+        "faults.install_from_env()\n"
+        "cfg = cluster.ClusterConfig(coordination_dir={d!r},"
+        " host_id='hX', rank=0, num_hosts=1)\n"
+        "ctx = cluster.ClusterContext(cfg, {d!r})\n"
+        "ctx.crash_point('boom')\n"
+        "print('survived')\n"
+    ).format(d=str(tmp_path))
+    env = os.environ.copy()
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        + os.pathsep
+        + env.get("PYTHONPATH", "")
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == cluster.CRASH_EXIT_CODE, proc.stderr
+    assert "survived" not in proc.stdout
+
+
+# -- leases, shards, fences ------------------------------------------
+
+
+def test_plan_shard_partitions_are_disjoint_and_covering(tmp_path):
+    names = [f"m{i}" for i in range(10)]
+    shards = []
+    for rank in range(3):
+        ctx = _ctx(tmp_path, host=f"h{rank}", rank=rank, num_hosts=3)
+        ctx.beat()
+        shards.append(ctx.plan_shard(list(names)))
+    flat = [n for s in shards for n in s]
+    assert sorted(flat) == sorted(names)  # covering
+    assert len(flat) == len(set(flat))    # disjoint
+    # leases are published
+    for rank, shard in enumerate(shards):
+        lease = json.load(
+            open(cluster.lease_path(str(tmp_path), f"h{rank}"))
+        )
+        assert lease["names"] == shard
+
+
+def test_plan_shard_excludes_live_peers_leases(tmp_path):
+    peer = _ctx(tmp_path, host="hB", rank=1, num_hosts=2)
+    peer.beat()
+    peer._lease_names = ["m1", "m3"]  # overlaps hA's natural slice
+    peer._write_lease()
+    ctx = _ctx(tmp_path, host="hA", rank=0, num_hosts=2)
+    ctx.beat()
+    mine = ctx.plan_shard(["m0", "m1", "m2", "m3"])
+    assert mine == ["m0"]  # m1 dropped: a live peer holds it
+
+
+def test_plan_shard_stagger_consistent_under_done_filter(tmp_path):
+    """A late-starting host sees completed work; the partition must
+    still split the FULL name list (splitting the done-filtered
+    remainder would shift every rank boundary and leave names
+    unowned)."""
+    names = ["a", "b", "c", "d"]
+    h0 = _ctx(tmp_path, host="h0", rank=0, num_hosts=2)
+    h0.beat()
+    assert h0.plan_shard(list(names)) == ["a", "b"]
+    # h0 completed 'a' by the time h1 starts: h1's slice is still
+    # the full-list rank-1 slice [c, d] — NOT shard([b,c,d], 1, 2)
+    h1 = _ctx(tmp_path, host="h1", rank=1, num_hosts=2)
+    h1.beat()
+    assert h1.plan_shard(list(names), done={"a"}) == ["c", "d"]
+    # and done names are dropped from the owner's own slice
+    h0b = _ctx(tmp_path, host="h0", rank=0, num_hosts=2)
+    h0b.beat()
+    assert h0b.plan_shard(list(names), done={"a"}) == ["b"]
+
+
+def test_plan_shard_reassigns_dead_peers_names(tmp_path):
+    peer = _ctx(tmp_path, host="hB", rank=1, num_hosts=2)
+    peer.beat()
+    peer._lease_names = ["m2", "m3"]
+    peer._write_lease()
+    _age_heartbeat(tmp_path, "hB", 60.0)  # silent for a minute
+
+    j = _journal(tmp_path, "hA")
+    ctx = _ctx(tmp_path, host="hA", rank=0, num_hosts=1)
+    ctx.beat()
+    mine = ctx.plan_shard(["m0", "m1", "m2", "m3"], j)
+    assert set(mine) == {"m0", "m1", "m2", "m3"}
+    assert ctx.reassigned == {"m2": "hB", "m3": "hB"}
+    events = {e["event"] for e in j.events()}
+    assert {"host_suspect", "host_fenced", "work_reassigned"} <= events
+    # the dead peer is fenced on disk
+    assert os.path.exists(cluster.fence_path(str(tmp_path), "hB"))
+    j.close()
+
+
+def test_plan_shard_strict_raises_on_dead_peer(tmp_path):
+    peer = _ctx(tmp_path, host="hB", rank=1, num_hosts=2)
+    peer.beat()
+    peer._lease_names = ["m1"]
+    peer._write_lease()
+    _age_heartbeat(tmp_path, "hB", 60.0)
+    ctx = _ctx(tmp_path, host="hA", rank=0, num_hosts=1)
+    ctx.beat()
+    with pytest.raises(cluster.HostLost):
+        ctx.plan_shard(["m0", "m1"], strict=True)
+
+
+def test_fence_claim_is_exclusive(tmp_path):
+    path = cluster.fence_path(str(tmp_path), "dead")
+    first = cluster.try_claim(path, {"fenced_by": "hA"})
+    second = cluster.try_claim(path, {"fenced_by": "hB"})
+    assert first is True and second is False
+    assert json.load(open(path))["fenced_by"] == "hA"
+
+
+@pytest.mark.faults
+def test_lease_race_fault_loses_claim(tmp_path):
+    path = cluster.fence_path(str(tmp_path), "dead")
+    with faults.fault_plan("lease_race::1"):
+        assert cluster.try_claim(path, {"fenced_by": "hA"}) is False
+        assert not os.path.exists(path)  # phantom winner: no file
+        # plan exhausted -> the retry wins for real
+        assert cluster.try_claim(path, {"fenced_by": "hA"}) is True
+
+
+def test_ensure_not_fenced_raises(tmp_path):
+    ctx = _ctx(tmp_path)
+    ctx.ensure_not_fenced()  # no fence: fine
+    cluster.try_claim(
+        cluster.fence_path(str(tmp_path), "hA"),
+        {"fenced_by": "hB"},
+    )
+    with pytest.raises(cluster.HostFenced):
+        ctx.ensure_not_fenced()
+
+
+# -- orphan harvest ---------------------------------------------------
+
+
+def _dead_peer_with_work(tmp_path, names, done=()):
+    """A crashed host hB: stale heartbeat, lease over ``names``,
+    journal recording only ``done``."""
+    peer = _ctx(tmp_path, host="hB", rank=1, num_hosts=2)
+    peer.beat()
+    peer._lease_names = list(names)
+    peer._write_lease()
+    _age_heartbeat(tmp_path, "hB", 60.0)
+    jb = _journal(tmp_path, "hB")
+    for nm in done:
+        jb.record(nm, "ok", out=nm + ".box")
+    jb.close()
+
+
+def test_harvest_claims_dead_peers_incomplete_work(tmp_path):
+    _dead_peer_with_work(
+        tmp_path, ["m2", "m3", "m4"], done=["m2"]
+    )
+    j = _journal(tmp_path, "hA")
+    ctx = _ctx(tmp_path, host="hA", rank=0, num_hosts=2)
+    ctx.beat()
+    ctx._lease_names = ["m0", "m1"]
+    ctx._write_lease()
+    got = ctx.harvest_orphans(j, ["m0", "m1", "m2", "m3", "m4"])
+    assert got == ["m3", "m4"]  # m2 was completed before the crash
+    assert ctx.reassigned == {"m3": "hB", "m4": "hB"}
+    # idempotent: a second harvest has nothing left to claim
+    # (the claimed names are now in our own lease)
+    assert ctx.harvest_orphans(
+        j, ["m0", "m1", "m2", "m3", "m4"]
+    ) == []
+    j.close()
+
+
+def test_harvest_strict_raises_host_lost(tmp_path):
+    _dead_peer_with_work(tmp_path, ["m1"])
+    j = _journal(tmp_path, "hA")
+    ctx = _ctx(tmp_path, host="hA", rank=0, num_hosts=2)
+    ctx.beat()
+    ctx._lease_names = ["m0"]
+    ctx._write_lease()
+    with pytest.raises(cluster.HostLost):
+        ctx.harvest_orphans(j, ["m0", "m1"], strict=True)
+    j.close()
+
+
+def test_harvest_skips_quarantined_and_done(tmp_path):
+    _dead_peer_with_work(tmp_path, ["m1", "m2"])
+    j = _journal(tmp_path, "hA")
+    j.record("m1", "quarantined", error={"type": "X"})
+    ctx = _ctx(tmp_path, host="hA", rank=0, num_hosts=2)
+    ctx.beat()
+    ctx._lease_names = ["m0"]
+    ctx._write_lease()
+    assert ctx.harvest_orphans(j, ["m0", "m1", "m2"]) == ["m2"]
+    j.close()
+
+
+def test_harvest_leaves_live_peers_alone(tmp_path):
+    peer = _ctx(tmp_path, host="hB", rank=1, num_hosts=2)
+    peer.start()  # actively renewing
+    try:
+        peer._lease_names = ["m1"]
+        peer._write_lease()
+        j = _journal(tmp_path, "hA")
+        ctx = _ctx(
+            tmp_path, host="hA", rank=0, num_hosts=2,
+            host_timeout_s=0.5,
+        )
+        ctx.beat()
+        ctx._lease_names = ["m0"]
+        ctx._write_lease()
+        # hB keeps renewing -> confirmed alive -> harvest returns
+        # empty instead of stealing
+        assert ctx.harvest_orphans(j, ["m0", "m1"]) == []
+        assert not os.path.exists(
+            cluster.fence_path(str(tmp_path), "hB")
+        )
+        j.close()
+    finally:
+        peer.stop()
+
+
+@pytest.mark.faults
+def test_harvest_fence_race_loser_does_not_take_over(tmp_path):
+    """Two survivors racing for a dead host's lease: the one whose
+    fence claim loses must NOT reassign — no lease extension, no
+    work_reassigned event, no double processing."""
+    _dead_peer_with_work(tmp_path, ["m1", "m2"])
+    j = _journal(tmp_path, "hA")
+    ctx = _ctx(tmp_path, host="hA", rank=0, num_hosts=3)
+    ctx.beat()
+    ctx._lease_names = ["m0"]
+    ctx._write_lease()
+    # lease_race: the O_EXCL claim reports a phantom concurrent
+    # winner exactly once -> this harvest's takeover must abort
+    with faults.fault_plan("lease_race::1"):
+        assert ctx.harvest_orphans(j, ["m0", "m1", "m2"]) == []
+    assert ctx.reassigned == {}
+    assert "work_reassigned" not in {
+        e["event"] for e in j.events()
+    }
+    # plan gone -> the next harvest wins the fence and takes over
+    assert ctx.harvest_orphans(j, ["m0", "m1", "m2"]) == ["m1", "m2"]
+    j.close()
+
+
+def test_restart_clears_own_stale_fence(tmp_path):
+    """A host relaunched under the same id after being fenced must
+    rejoin: start() clears the stale fence, peers see it live again,
+    and ensure_not_fenced passes."""
+    cluster.try_claim(
+        cluster.fence_path(str(tmp_path), "hA"),
+        {"host": "hA", "fenced_by": "hB", "ts": 0},
+    )
+    ctx = _ctx(tmp_path, host="hA")
+    ctx.start()
+    try:
+        ctx.ensure_not_fenced()  # must not raise
+        view = cluster.read_liveness(str(tmp_path), 5.0)
+        assert view["hA"].rung == HOST_LIVE
+    finally:
+        ctx.stop()
+
+
+def test_harvest_respects_competing_survivors_fence(tmp_path):
+    _dead_peer_with_work(tmp_path, ["m1"])
+    # another survivor (hC) already fenced hB
+    cluster.try_claim(
+        cluster.fence_path(str(tmp_path), "hB"),
+        {"host": "hB", "fenced_by": "hC"},
+    )
+    j = _journal(tmp_path, "hA")
+    ctx = _ctx(tmp_path, host="hA", rank=0, num_hosts=3)
+    ctx.beat()
+    ctx._lease_names = ["m0"]
+    ctx._write_lease()
+    assert ctx.harvest_orphans(j, ["m0", "m1"]) == []
+    j.close()
+
+
+# -- per-host journals: merge-on-read ---------------------------------
+
+
+def test_cluster_journal_records_carry_host(tmp_path):
+    j = _journal(tmp_path, "hA")
+    j.record("m0", "ok")
+    j.record_event("work_reassigned", from_host="hB", count=1)
+    j.close()
+    entries = journal.read_all_journals(str(tmp_path))
+    assert all(e["host"] == "hA" for e in entries)
+    assert os.path.exists(
+        os.path.join(str(tmp_path), "_journal.hA.jsonl")
+    )
+
+
+def test_merge_duplicate_names_last_writer_wins(tmp_path):
+    ja = _journal(tmp_path, "hA")
+    jb = _journal(tmp_path, "hB")
+    ja.record("m0", "quarantined", error={"type": "X"})
+    time.sleep(0.01)
+    jb.record("m0", "ok")  # later reassignment succeeded
+    ja.close()
+    jb.close()
+    latest = journal.merged_latest(str(tmp_path))
+    assert latest["m0"]["status"] == "ok"
+    assert latest["m0"]["host"] == "hB"
+    # and the reverse order in a different run dir
+    d2 = os.path.join(str(tmp_path), "rev")
+    ja = _journal(d2, "hA")
+    jb = _journal(d2, "hB")
+    jb.record("m0", "ok")
+    time.sleep(0.01)
+    ja.record("m0", "degraded")
+    ja.close()
+    jb.close()
+    assert journal.merged_latest(d2)["m0"]["status"] == "degraded"
+
+
+def test_merge_tolerates_torn_trailing_lines(tmp_path):
+    ja = _journal(tmp_path, "hA")
+    ja.record("m0", "ok")
+    ja.close()
+    # hB crashed mid-append: torn JSON tail
+    with open(
+        os.path.join(str(tmp_path), "_journal.hB.jsonl"), "w"
+    ) as f:
+        f.write(
+            json.dumps(
+                {"name": "m1", "status": "ok", "ts": time.time(),
+                 "host": "hB"}
+            )
+            + "\n"
+        )
+        f.write('{"name": "m2", "status": "o')  # torn by the crash
+    latest = journal.merged_latest(str(tmp_path))
+    assert set(latest) == {"m0", "m1"}
+    # resume through the merged loader sees the same view
+    j = _journal(tmp_path, "hC")
+    assert set(j.done_names()) == {"m0", "m1"}
+    j.close()
+
+
+def test_cluster_resume_with_changed_host_set(tmp_path):
+    """The manifest pins content config, NOT the host set: a resume
+    generation with entirely different hosts must adopt the merged
+    journal instead of restarting."""
+    for host, nm in (("gen1a", "m0"), ("gen1b", "m1")):
+        j = _journal(tmp_path, host)
+        j.record(nm, "ok")
+        j.close()
+    j = _journal(tmp_path, "gen2solo")
+    assert j.resumed
+    assert j.done_names() == {"m0", "m1"}
+    j.close()
+
+
+def test_cluster_manifest_mismatch_raises(tmp_path):
+    j = _journal(tmp_path, "hA")
+    j.record("m0", "ok")
+    j.close()
+    with pytest.raises(journal.ManifestMismatch):
+        journal.RunJournal.open(
+            str(tmp_path), {"cfg": 2}, host="hB", cluster=True
+        )
+    # the existing journals were NOT deleted by the failed open
+    assert os.path.exists(
+        os.path.join(str(tmp_path), "_journal.hA.jsonl")
+    )
+
+
+def test_plain_journal_unaffected_by_host_files(tmp_path):
+    """The single-process read_journal keeps its historical contract
+    (base file only); read_all_journals is the merged view."""
+    j = journal.RunJournal.open(str(tmp_path), {"cfg": 1})
+    j.record("m0", "ok")
+    j.close()
+    jb = _journal(tmp_path, "hB")
+    jb.record("m1", "ok")
+    jb.close()
+    assert {e["name"] for e in journal.read_journal(str(tmp_path))} == {
+        "m0"
+    }
+    assert {
+        e["name"]
+        for e in journal.read_all_journals(str(tmp_path))
+        if "name" in e
+    } == {"m0", "m1"}
+
+
+def test_report_cluster_section_from_journals(tmp_path):
+    """`repic-tpu report` over per-host journals: merged tallies,
+    per-host outcomes, suspicion/fence/reassignment counters —
+    jax-free, straight off the files."""
+    from repic_tpu.telemetry.report import build_report, format_report
+
+    jb = _journal(tmp_path, "hB")
+    jb.record("m1", "ok")
+    jb.close()
+    ja = _journal(tmp_path, "hA")
+    ja.record("m0", "ok")
+    ja.record_event("host_suspect", suspect="hB", rung="suspect")
+    ja.record_event("host_fenced", suspect="hB", by="hA")
+    ja.record_event(
+        "work_reassigned",
+        from_host="hB",
+        to_host="hA",
+        names=["m2"],
+        count=1,
+    )
+    ja.record("m2", "ok", reassigned_from="hB")
+    ja.close()
+
+    r = build_report(str(tmp_path))
+    assert r["micrographs"]["total"] == 3
+    cl = r["cluster"]
+    assert cl["suspects"] == 1 and cl["fences"] == 1
+    assert cl["reassignments"] == {"events": 1, "micrographs": 1}
+    assert set(cl["hosts"]) == {"hA", "hB"}
+    assert cl["hosts"]["hA"]["by_status"] == {"ok": 2}
+    assert cl["hosts"]["hA"]["reassigned_in"] == 1
+    text = format_report(r)
+    assert "cluster hosts:" in text
+    assert "host ladder: suspects=1 fences=1 reassigned=1" in text
+
+
+def test_report_without_hosts_has_no_cluster_section(tmp_path):
+    j = journal.RunJournal.open(str(tmp_path), {"cfg": 1})
+    j.record("m0", "ok")
+    j.close()
+    from repic_tpu.telemetry.report import build_report, format_report
+
+    r = build_report(str(tmp_path))
+    assert "cluster" not in r
+    assert "cluster hosts:" not in format_report(r)
+
+
+# -- CLI wiring -------------------------------------------------------
+
+
+def test_cli_heartbeat_flags_require_coordination_dir():
+    import argparse
+
+    from repic_tpu.commands import consensus as cmd
+
+    p = argparse.ArgumentParser()
+    cmd.add_arguments(p)
+    args = p.parse_args(["in", "out", "48", "--host-timeout", "5"])
+    with pytest.raises(SystemExit, match="coordination-dir"):
+        cmd.main(args)
+
+
+def test_cli_cluster_smoke(tmp_path, capsys, monkeypatch):
+    """The full CLI surface: --coordination-dir enables cluster mode,
+    identity comes from env, stats JSON carries the cluster block,
+    and the per-host journal lands next to the outputs."""
+    import argparse
+
+    from repic_tpu.commands import consensus as cmd
+
+    fixture = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "fixtures",
+        "mini10017",
+    )
+    monkeypatch.setenv("REPIC_TPU_HOST_ID", "cliH")
+    monkeypatch.setenv("REPIC_TPU_HOST_RANK", "0")
+    monkeypatch.setenv("REPIC_TPU_NUM_HOSTS", "1")
+    out = tmp_path / "out"
+    p = argparse.ArgumentParser()
+    cmd.add_arguments(p)
+    args = p.parse_args(
+        [
+            fixture,
+            str(out),
+            "180",
+            "--no_mesh",
+            "--coordination-dir", str(out),
+            "--heartbeat-interval", "0.2",
+            "--host-timeout", "1.0",
+        ]
+    )
+    cmd.main(args)
+    stats = json.loads(capsys.readouterr().out)
+    assert stats["cluster"]["host"] == "cliH"
+    assert stats["journal"] == {"ok": 3}
+    assert os.path.exists(str(out / "_journal.cliH.jsonl"))
+    assert os.path.exists(str(out / "_heartbeat.cliH.json"))
+
+
+def test_resolve_identity_from_env(monkeypatch):
+    monkeypatch.setenv("REPIC_TPU_HOST_ID", "node-7/a")
+    monkeypatch.setenv("REPIC_TPU_HOST_RANK", "2")
+    monkeypatch.setenv("REPIC_TPU_NUM_HOSTS", "4")
+    host, rank, num = cluster.resolve_identity()
+    assert (rank, num) == (2, 4)
+    assert "/" not in host  # sanitized for file names
+    for var in (
+        "REPIC_TPU_HOST_ID",
+        "REPIC_TPU_HOST_RANK",
+        "REPIC_TPU_NUM_HOSTS",
+    ):
+        monkeypatch.delenv(var)
+    assert cluster.resolve_identity() == ("host0", 0, 1)

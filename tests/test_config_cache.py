@@ -12,6 +12,7 @@ sidecar; these tests point HOME at a tmpdir and re-enable it.
 
 import json
 import os
+import time
 
 import numpy as np
 import pytest
@@ -49,9 +50,14 @@ def clean_config_state():
         dict(C._LAST_PERSISTED),
     )
     # start each test from clean module state (the write-skip memo in
-    # particular would otherwise suppress rewrites across params)
+    # particular would otherwise suppress rewrites across params).
+    # _CONFIG_CACHE_LOADED is reset too: the latch is set on the
+    # first consensus call even while the conftest disables the
+    # cache, so without a reset no test in this file would ever load
+    # its own tmp-HOME sidecar (see _load_persisted_configs).
     C._RECENT_REQUIREMENTS.clear()
     C._LAST_PERSISTED.clear()
+    C._CONFIG_CACHE_LOADED = False
     yield
     C._LAST_GOOD_CONFIG.clear()
     C._LAST_GOOD_CONFIG.update(saved[0])
@@ -135,3 +141,77 @@ def test_opt_outs_disable_persistence(
     assert C._config_cache_path() is None
     monkeypatch.delenv("REPIC_TPU_NO_CACHE")
     assert C._config_cache_path() is not None
+
+
+# Each concurrent writer persists this many distinct keys; 2 writers
+# x 12 keys = 24 entries, comfortably under the sidecar's last-64
+# trim (the trim must never be what hides a lost update).
+_N_KEYS = 12
+
+_WRITER_CODE = """
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+tag, start_file = sys.argv[1], sys.argv[2]
+from repic_tpu.pipeline import consensus as C
+# both processes spin until the start file exists, so their
+# read-merge-replace cycles actually interleave
+deadline = time.time() + 60
+while not os.path.exists(start_file):
+    if time.time() > deadline:
+        sys.exit(3)
+    time.sleep(0.001)
+for i in range({n}):
+    key = ((2, 3, 8, int(tag), i), (180.0,), 0.3, False)
+    C._persist_config(key, (8, 1024, 64, 1024))
+""".format(n=_N_KEYS)
+
+
+def test_concurrent_persist_loses_no_updates(tmp_path, monkeypatch):
+    """Lost-update regression (ADVICE.md round 5): two processes
+    interleaving read-merge-replace cycles on the sidecar must not
+    drop each other's entries.  Deterministic with the file_lock held
+    across the cycle; without it this flakes (a writer replaces the
+    file with a merge that predates the other's append)."""
+    import subprocess
+    import sys
+
+    env = os.environ.copy()
+    env["HOME"] = str(tmp_path)
+    env.pop("REPIC_TPU_NO_CONFIG_CACHE", None)
+    env.pop("REPIC_TPU_NO_CACHE", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    start_file = str(tmp_path / "go")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WRITER_CODE, tag, start_file],
+            env=env,
+            cwd=repo,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for tag in ("1", "2")
+    ]
+    time.sleep(0.2)  # let both reach the spin loop (imports done or
+    # not — the spin is what synchronizes them)
+    with open(start_file, "w") as f:
+        f.write("go")
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        assert p.returncode == 0, out[-2000:]
+
+    path = os.path.join(
+        str(tmp_path), ".cache", "repic_tpu", "capacity_configs.json"
+    )
+    entries = json.load(open(path))
+    keys = {tuple(map(tuple, [e["key"][0]])) for e in entries}
+    # every key from BOTH writers survived the interleaving
+    expected = {
+        ((2, 3, 8, tag, i),)
+        for tag in (1, 2)
+        for i in range(_N_KEYS)
+    }
+    assert keys == expected, (
+        f"lost {len(expected) - len(keys & expected)} update(s)"
+    )
