@@ -1,0 +1,74 @@
+"""Shared observability flag surface for runnable CLI commands.
+
+``consensus``, ``pick``, and ``fit`` all take the same two
+device-time attribution flags; this module implements the argparse
+block and the scoped runtime wiring ONCE so the three command
+modules cannot drift (the same single-source rule the per-host
+artifact scheme follows via ``journal.sanitize_host_id`` /
+``host_artifact_paths``).
+
+jax-free at import: safe for the two-phase CLI dispatch, which must
+keep ``--help`` free of backend startup cost.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+def add_observability_arguments(
+    parser,
+    *,
+    trace_flags: tuple = ("--trace-dir",),
+    trace_dest: str = "trace_dir",
+) -> None:
+    """Register ``--trace-dir`` and ``--device-time``.
+
+    ``consensus`` passes ``trace_flags=("--profile", "--trace-dir")``
+    with ``trace_dest="profile"`` — its historical flag name stays
+    the canonical spelling there, with ``--trace-dir`` as the alias
+    shared with ``pick``/``fit``.
+    """
+    parser.add_argument(
+        *trace_flags,
+        dest=trace_dest,
+        metavar="DIR",
+        help="write a jax.profiler device trace to DIR (view with "
+        "TensorBoard/Perfetto; `repic-tpu report` parses it into "
+        "the device-time section)",
+    )
+    parser.add_argument(
+        "--device-time",
+        action="store_true",
+        help="device-time attribution: bracket every telemetry span "
+        "with a device sync so the event stream (and `repic-tpu "
+        "report`) splits each stage into host time vs device tail. "
+        "Serializes stages — a measurement mode, not a fast path",
+    )
+
+
+_UNSET = object()
+
+
+@contextlib.contextmanager
+def observability_scope(args, trace_dir=_UNSET):
+    """Scoped ``--device-time`` + ``--trace-dir`` wiring.
+
+    Attribution mode is a process-wide latch, so it restores on exit
+    (one device-timed CLI run must not leave every later in-process
+    run paying span-boundary syncs), and the profiler session closes
+    with the scope.  Enter this INSIDE a command's telemetry
+    try/finally: a failing trace dir must still finish the run
+    telemetry.  ``trace_dir`` defaults to ``args.trace_dir``;
+    commands with a different dest (``consensus``'s ``--profile``)
+    pass theirs explicitly — an explicit ``None`` (flag unset) stays
+    ``None``.
+    """
+    from repic_tpu.telemetry import probes
+    from repic_tpu.utils.tracing import trace_session
+
+    if trace_dir is _UNSET:
+        trace_dir = args.trace_dir
+    with probes.device_time(args.device_time), \
+            trace_session(trace_dir):
+        yield

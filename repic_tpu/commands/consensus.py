@@ -54,11 +54,26 @@ def add_arguments(parser):
         help="bucketed neighbor search for dense micrographs "
         "(auto: by particle count)",
     )
+    from repic_tpu.commands._observability import (
+        add_observability_arguments,
+    )
+
+    add_observability_arguments(
+        parser,
+        trace_flags=("--profile", "--trace-dir"),
+        trace_dest="profile",
+    )
     parser.add_argument(
-        "--profile",
-        metavar="DIR",
-        help="write a jax.profiler device trace to DIR "
-        "(view with TensorBoard/Perfetto)",
+        "--status-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve live observability on 127.0.0.1:PORT while the "
+        "run executes: /metrics (Prometheus exposition of the live "
+        "registry), /status (run id, chunk progress, ladder/"
+        "quarantine tallies, cluster liveness), /healthz.  PORT 0 "
+        "binds an ephemeral port (printed on stderr).  Off by "
+        "default — unset means nothing is bound or spawned",
     )
     parser.add_argument(
         "--solver",
@@ -166,9 +181,12 @@ def add_arguments(parser):
 
 
 def main(args):
+    import sys
+
+    from repic_tpu.commands._observability import observability_scope
     from repic_tpu.pipeline.consensus import run_consensus_dir
     from repic_tpu.runtime.ladder import RetryPolicy
-    from repic_tpu.utils.tracing import trace_session
+    from repic_tpu.telemetry.server import maybe_status_server
 
     if args.solver_budget is not None and args.solver != "exact":
         raise SystemExit(
@@ -202,27 +220,34 @@ def main(args):
         if args.retries is not None
         else None
     )
-    with trace_session(args.profile):
-        stats = run_consensus_dir(
-            args.in_dir,
-            args.out_dir,
-            args.box_size,
-            threshold=args.threshold,
-            max_neighbors=args.max_neighbors,
-            num_particles=args.num_particles,
-            use_mesh=not args.no_mesh,
-            spatial=spatial,
-            solver=args.solver,
-            use_pallas=args.pallas,
-            multi_out=args.multi_out,
-            get_cc=args.get_cc,
-            stripes=args.stripes,
-            resume=args.resume,
-            strict=args.strict,
-            retry_policy=policy,
-            solver_budget_s=args.solver_budget,
-            cluster=cluster,
-        )
+    with maybe_status_server(args.status_port) as srv:
+        if srv is not None:
+            print(
+                f"status server: http://127.0.0.1:{srv.port} "
+                "(/metrics /status /healthz)",
+                file=sys.stderr,
+            )
+        with observability_scope(args, args.profile):
+            stats = run_consensus_dir(
+                args.in_dir,
+                args.out_dir,
+                args.box_size,
+                threshold=args.threshold,
+                max_neighbors=args.max_neighbors,
+                num_particles=args.num_particles,
+                use_mesh=not args.no_mesh,
+                spatial=spatial,
+                solver=args.solver,
+                use_pallas=args.pallas,
+                multi_out=args.multi_out,
+                get_cc=args.get_cc,
+                stripes=args.stripes,
+                resume=args.resume,
+                strict=args.strict,
+                retry_policy=policy,
+                solver_budget_s=args.solver_budget,
+                cluster=cluster,
+            )
     print(json.dumps(stats, default=str, indent=2))
 
 

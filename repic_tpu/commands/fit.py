@@ -107,6 +107,11 @@ def add_arguments(parser) -> None:
         help="bfloat16 conv/matmul compute (MXU-native, half the HBM "
         "traffic); parameters, loss, and optimizer state stay float32",
     )
+    from repic_tpu.commands._observability import (
+        add_observability_arguments,
+    )
+
+    add_observability_arguments(parser)
 
 
 def main(args) -> None:
@@ -217,20 +222,24 @@ def main(args) -> None:
     import os
 
     from repic_tpu import telemetry
+    from repic_tpu.commands._observability import observability_scope
 
     run_tlm = telemetry.start_run(
         os.path.dirname(os.path.abspath(args.model_out))
     )
     try:
-        result = fit(
-            train_data,
-            train_labels,
-            val_data,
-            val_labels,
-            config,
-            init_params=init_params,
-            arch=args.arch,
-        )
+        # scoped INSIDE the try: a failing trace-dir must still
+        # finish the run telemetry
+        with observability_scope(args):
+            result = fit(
+                train_data,
+                train_labels,
+                val_data,
+                val_labels,
+                config,
+                init_params=init_params,
+                arch=args.arch,
+            )
     finally:
         telemetry.finish_run(run_tlm)
     save_checkpoint(

@@ -68,6 +68,11 @@ def add_arguments(parser) -> None:
         help="bfloat16 conv compute for scoring (MXU-native); "
         "score maps match float32 to ~1e-2",
     )
+    from repic_tpu.commands._observability import (
+        add_observability_arguments,
+    )
+
+    add_observability_arguments(parser)
 
 
 def _write_star(path: str, coords: np.ndarray) -> None:
@@ -114,44 +119,48 @@ def main(args) -> None:
     # metric snapshots next to the coordinate files, like consensus
     # runs do (docs/observability.md).
     from repic_tpu import telemetry
+    from repic_tpu.commands._observability import observability_scope
 
     run_tlm = telemetry.start_run(args.out_dir)
     try:
-        for path in mrcs:
-            t0 = time.perf_counter()
-            stem = os.path.splitext(os.path.basename(path))[0]
-            with tlm_events.span("pick_micrograph", micrograph=stem):
-                raw = mrc.read_mrc(path).astype(np.float32)
-                if raw.ndim == 3:  # single-frame stack
-                    raw = raw[0]
-                coords = pick_micrograph(
-                    params,
-                    raw,
-                    int(particle_size),
-                    mode=args.mode,
-                    norm=norm,
-                    arch=meta.get("arch", "deep"),
-                    dtype="bfloat16" if args.bf16 else "float32",
+        # scoped INSIDE the try: a failing trace-dir must still
+        # finish the run telemetry
+        with observability_scope(args):
+            for path in mrcs:
+                t0 = time.perf_counter()
+                stem = os.path.splitext(os.path.basename(path))[0]
+                with tlm_events.span("pick_micrograph", micrograph=stem):
+                    raw = mrc.read_mrc(path).astype(np.float32)
+                    if raw.ndim == 3:  # single-frame stack
+                        raw = raw[0]
+                    coords = pick_micrograph(
+                        params,
+                        raw,
+                        int(particle_size),
+                        mode=args.mode,
+                        norm=norm,
+                        arch=meta.get("arch", "deep"),
+                        dtype="bfloat16" if args.bf16 else "float32",
+                    )
+                coords = coords[coords[:, 2] >= args.threshold]
+                if args.format == "star":
+                    _write_star(
+                        os.path.join(args.out_dir, stem + ".star"), coords
+                    )
+                else:
+                    # BOX rows are lower-left corners (center - size/2),
+                    # matching the converter's center->corner shift
+                    # (reference coord_converter.py:366-374).
+                    write_box(
+                        os.path.join(args.out_dir, stem + ".box"),
+                        coords[:, :2] - particle_size / 2,
+                        coords[:, 2],
+                        int(particle_size),
+                    )
+                _log.info(
+                    f"{stem}: {len(coords)} particles "
+                    f"({time.perf_counter() - t0:.1f}s)"
                 )
-            coords = coords[coords[:, 2] >= args.threshold]
-            if args.format == "star":
-                _write_star(
-                    os.path.join(args.out_dir, stem + ".star"), coords
-                )
-            else:
-                # BOX rows are lower-left corners (center - size/2),
-                # matching the converter's center->corner shift
-                # (reference coord_converter.py:366-374).
-                write_box(
-                    os.path.join(args.out_dir, stem + ".box"),
-                    coords[:, :2] - particle_size / 2,
-                    coords[:, 2],
-                    int(particle_size),
-                )
-            _log.info(
-                f"{stem}: {len(coords)} particles "
-                f"({time.perf_counter() - t0:.1f}s)"
-            )
     finally:
         telemetry.finish_run(run_tlm)
 

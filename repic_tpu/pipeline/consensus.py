@@ -42,7 +42,12 @@ from repic_tpu.parallel.mesh import (
 )
 from repic_tpu.runtime import faults
 from repic_tpu.runtime.atomic import atomic_write
-from repic_tpu.runtime.journal import RunJournal, error_info
+from repic_tpu.runtime.journal import (
+    DONE_STATUSES,
+    STATUS_QUARANTINED,
+    RunJournal,
+    error_info,
+)
 from repic_tpu.runtime.ladder import (
     DEFAULT_POLICY,
     ChunkOutcomes,
@@ -52,6 +57,7 @@ from repic_tpu.runtime.ladder import (
     solve_host_ladder,
 )
 from repic_tpu.telemetry import events as tlm_events
+from repic_tpu.telemetry import server as tlm_server
 from repic_tpu.utils import box_io
 
 _log = tlm_events.get_logger("consensus")
@@ -717,7 +723,20 @@ def run_consensus_batch(
         xy, conf, mask = batch.xy, batch.conf, batch.mask
         if mesh is not None:
             xy, conf, mask = shard_over_micrographs(mesh, xy, conf, mask)
-        res = fn(xy, conf, mask, box_arg)
+        # Device-time attribution happens HERE, not at the chunk
+        # span: the chunk span contains the blocking probe/result
+        # fetch, which drains the device before span exit — its
+        # device tail is ~0 by construction.  This span closes right
+        # after the async dispatch, so in --device-time mode its
+        # host_s is pure host trace/dispatch work and its
+        # device_tail_s is the batch's actual device execution — the
+        # split the dispatch-gap estimate is computed from.
+        with tlm_events.span(
+            "consensus_dispatch",
+            micrographs=int(np.shape(batch.xy)[0]),
+            capacity=batch.capacity,
+        ):
+            res = fn(xy, conf, mask, box_arg)
         # The four probes are reduced on device and fetched in ONE
         # transfer: per-scalar fetches each pay a full host<->device
         # round trip (expensive over a tunneled TPU).  In packed mode
@@ -1386,8 +1405,34 @@ def run_consensus_dir(
             os.makedirs(out_dir, exist_ok=True)
             journal = RunJournal.open(out_dir, run_config)
     # Telemetry run scope (docs/observability.md): the event log lives
-    # next to the journal; the metric sinks are written at each exit.
-    run_tlm = telemetry.start_run(out_dir)
+    # next to the journal; the metric sinks stream (periodic flusher +
+    # chunk-boundary flushes below) and are finalized at exit.  In
+    # cluster mode every host writes its OWN _events.<host>.jsonl /
+    # _metrics.<host>.json (the shared out_dir makes the plain names
+    # a clobber hazard); `repic-tpu report` merges them on read.
+    run_tlm = telemetry.start_run(
+        out_dir,
+        host=cluster_ctx.host if cluster_ctx is not None else None,
+    )
+    tlm_server.set_status(
+        run_id=run_tlm.log.run_id if run_tlm.log is not None else None,
+        out_dir=os.path.abspath(out_dir),
+        phase="loading",
+        micrographs_total=len(names),
+        chunks_done=0,
+    )
+    if cluster_ctx is not None:
+        tlm_server.set_status(
+            cluster={
+                "host": cluster_ctx.host,
+                "rank": cluster_ctx.rank,
+                "num_hosts": cluster_ctx.num_hosts,
+                "coordination_dir": os.path.abspath(
+                    cluster_ctx.coord_dir
+                ),
+                "host_timeout_s": cluster_ctx.cfg.host_timeout_s,
+            }
+        )
     try:
         out_ext = ".tsv" if multi_out else ".box"
         already_done = set()
@@ -1552,6 +1597,19 @@ def run_consensus_dir(
                     solver=solver, out=name + ".box",
                     particles=counts[name],
                 )
+                # striped micrographs are large (that is why they
+                # stripe) — stream the sinks and /status per
+                # micrograph, the path's natural chunk boundary
+                telemetry.flush_run(run_tlm)
+                tlm_server.set_status(
+                    phase="running",
+                    chunks_done=len(counts),
+                    micrographs_done=len(already_done)
+                    + len(counts)
+                    + len(skipped)
+                    + len(quarantined),
+                    quarantined=len(quarantined),
+                )
             timer.stages.append(("compute", compute_s))
             timer.stages.append(("write", write_s))
             timer.write_tsv(out_dir, "consensus_runtime.tsv")
@@ -1685,6 +1743,57 @@ def run_consensus_dir(
                     journal.record(
                         nm, outcomes.status.get(nm, "ok"), **fields
                     )
+                # Live observability plane: refresh the metric sinks
+                # and the /status document at every chunk boundary (a
+                # scrape mid-run sees current progress, not the
+                # previous run's finish_run snapshot).
+                telemetry.flush_run(run_tlm)
+                ladder_tally: dict = {}
+                for s in outcomes.status.values():
+                    ladder_tally[s] = ladder_tally.get(s, 0) + 1
+                # /status progress covers the WHOLE run, not just
+                # this process's share: resume-skipped names count
+                # as done, and a cluster host counts its peers'
+                # journaled completions (incremental merged view)
+                # so done/total never reads 1/N on an N-host run.
+                if cluster_ctx is not None:
+                    # one scope for every /status count: the merged
+                    # journal view (own + peers').  Journaled
+                    # quarantines count as processed, same as the
+                    # single-process arithmetic below — and the
+                    # quarantined tally must come from the SAME
+                    # merged view, or one host's endpoint would show
+                    # the run complete while hiding a peer's
+                    # quarantines.
+                    merged = cluster_ctx.merged_latest()
+                    q_count = sum(
+                        1
+                        for e in merged.values()
+                        if e.get("status") == STATUS_QUARANTINED
+                    )
+                    done = q_count + sum(
+                        1
+                        for e in merged.values()
+                        if e.get("status") in DONE_STATUSES
+                    )
+                else:
+                    done = (
+                        len(already_done)
+                        + len(counts)
+                        + len(skipped)
+                        + len(quarantined)
+                        + len(outcomes.quarantined)
+                    )
+                    q_count = len(quarantined) + len(
+                        outcomes.quarantined
+                    )
+                tlm_server.set_status(
+                    phase="running",
+                    chunks_done=len(parts),
+                    micrographs_done=done,
+                    quarantined=q_count,
+                    ladder=ladder_tally,
+                )
                 if cluster_ctx is not None:
                     # host_crash fault site + wedged-host exit: a
                     # fenced host must stop before touching the next
@@ -1739,6 +1848,7 @@ def run_consensus_dir(
         if cluster_ctx is not None:
             cluster_ctx.stop()
         telemetry.finish_run(run_tlm)
+        tlm_server.set_status(phase="finished")
 
 
 def iter_consensus_chunks(
@@ -1872,7 +1982,7 @@ def iter_consensus_chunks(
                 try:
                     with tlm_events.span(
                         "consensus_micrograph", micrograph=name,
-                        attempt=attempt,
+                        attempt=attempt, capacity=nb,
                     ):
                         faults.inject("oom", mkey)
                         faults.inject("io", mkey)
@@ -1914,7 +2024,12 @@ def iter_consensus_chunks(
         t1 = time.time()
         try:
             with tlm_events.span(
-                "consensus_chunk", micrographs=len(part)
+                "consensus_chunk",
+                micrographs=len(part),
+                # padded particle capacity: device-time attribution
+                # is reported per capacity bucket (each bucket is its
+                # own compiled program)
+                capacity=cbatch.capacity,
             ):
                 faults.inject("oom", ckey)
                 faults.inject("io", ckey)

@@ -685,6 +685,13 @@ class ClusterContext:
 
     # -- reporting ----------------------------------------------------
 
+    def merged_latest(self) -> dict:
+        """Latest entry per micrograph over ALL hosts' journals, via
+        the incremental size-keyed reader — the run-wide truth that
+        peers' in-flight completions land in.  Used by the orphan
+        harvest and by /status cluster-wide progress."""
+        return self._merged.latest()
+
     def stats(self) -> dict:
         """Summary block for the run's stats JSON."""
         return {

@@ -68,17 +68,38 @@ def host_journal_name(host: str) -> str:
     return f"_journal.{sanitize_host_id(host)}.jsonl"
 
 
+def host_artifact_paths(
+    out_dir: str, base_name: str
+) -> list[tuple[str | None, str]]:
+    """``(host, path)`` for every instance of a per-run artifact.
+
+    The discovery half of the per-host artifact scheme (the naming
+    half is :func:`sanitize_host_id`): the single-process
+    ``<stem><ext>`` file (host ``None``) first, then every per-host
+    ``<stem>.<host><ext>``, hosts sorted.  Shared by the journal,
+    the telemetry event log, and the metric snapshots so the scheme
+    cannot drift per artifact kind.
+    """
+    stem, ext = os.path.splitext(base_name)
+    out: list[tuple[str | None, str]] = []
+    base = os.path.join(out_dir, base_name)
+    if os.path.exists(base):
+        out.append((None, base))
+    for path in sorted(
+        glob.glob(os.path.join(out_dir, f"{stem}.*{ext}"))
+    ):
+        host = os.path.basename(path)[len(stem) + 1 : -len(ext)]
+        out.append((host, path))
+    return out
+
+
 def journal_paths(out_dir: str) -> list[str]:
     """Every journal file of a run: the single-process ``_journal.jsonl``
     plus any per-host ``_journal.<host>.jsonl``, in sorted order."""
-    paths = []
-    base = os.path.join(out_dir, JOURNAL_NAME)
-    if os.path.exists(base):
-        paths.append(base)
-    paths.extend(
-        sorted(glob.glob(os.path.join(out_dir, "_journal.*.jsonl")))
-    )
-    return paths
+    return [
+        path
+        for _, path in host_artifact_paths(out_dir, JOURNAL_NAME)
+    ]
 
 
 class ManifestMismatch(ValueError):

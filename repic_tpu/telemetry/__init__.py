@@ -23,12 +23,25 @@ Run lifecycle (used by :func:`run_consensus_dir`)::
 
     rt = telemetry.start_run(out_dir)     # _events.jsonl + probes
     ... spans / counters fire ...
+    telemetry.flush_run(rt)               # streaming sink refresh
     telemetry.finish_run(rt)              # _metrics.json / .prom
+
+The sinks STREAM: a background flusher rewrites the metric snapshots
+every ``REPIC_TPU_FLUSH_S`` seconds (default 10; 0 disables) and the
+pipeline calls :func:`flush_run` at every chunk boundary, so
+``_metrics.json`` / ``_metrics.prom`` are live mid-run instead of
+appearing only at ``finish_run`` — the file-based half of the live
+observability plane (the HTTP half is
+:mod:`repic_tpu.telemetry.server`).  Cluster runs pass
+``host=`` and write per-host ``_events.<host>.jsonl`` /
+``_metrics.<host>.json`` mirroring the per-host journal scheme;
+``repic-tpu report`` merges them on read.
 """
 
 from __future__ import annotations
 
 import os
+import threading
 
 from repic_tpu.telemetry import events, metrics, probes, sinks
 from repic_tpu.telemetry.events import (  # noqa: F401
@@ -52,70 +65,179 @@ from repic_tpu.telemetry.sinks import (  # noqa: F401
 )
 
 
+#: streaming-flush period (seconds); 0 disables the background thread
+DEFAULT_FLUSH_INTERVAL_S = 10.0
+
+
+def _flush_interval() -> float:
+    try:
+        return float(
+            os.environ.get(
+                "REPIC_TPU_FLUSH_S", DEFAULT_FLUSH_INTERVAL_S
+            )
+        )
+    except ValueError:
+        return DEFAULT_FLUSH_INTERVAL_S
+
+
 class RunTelemetry:
     """Handle pairing :func:`start_run` with :func:`finish_run`."""
 
     __slots__ = (
         "out_dir", "log", "prev", "finished", "probes0", "registry0",
+        "host", "json_path", "prom_path", "_lock", "_flush_stop",
+        "_flusher",
     )
 
     def __init__(self, out_dir, log, prev, probes0=None,
-                 registry0=None):
+                 registry0=None, host=None):
         self.out_dir = out_dir
         self.log = log
         self.prev = prev
         self.probes0 = probes0
         self.registry0 = registry0
+        self.host = host
+        self.json_path = os.path.join(
+            out_dir,
+            sinks.host_metrics_json_name(host)
+            if host
+            else sinks.METRICS_JSON_NAME,
+        )
+        self.prom_path = os.path.join(
+            out_dir,
+            sinks.host_metrics_prom_name(host)
+            if host
+            else sinks.METRICS_PROM_NAME,
+        )
         self.finished = False
+        self._lock = threading.Lock()
+        self._flush_stop: threading.Event | None = None
+        self._flusher: threading.Thread | None = None
 
 
-def start_run(out_dir: str, run_id: str | None = None) -> RunTelemetry:
+def start_run(
+    out_dir: str,
+    run_id: str | None = None,
+    host: str | None = None,
+    flush_interval_s: float | None = None,
+) -> RunTelemetry:
     """Open the per-run event log in ``out_dir`` and arm the probes.
 
-    Inert (no files, no listener) when telemetry is disabled — the
-    run then leaves only the journal behind and ``repic-tpu report``
-    degrades to journal-only tallies.  Probe counters and the
-    registry are baselined here so the run's sinks report THIS run's
-    numbers even when many runs share one process (iterative rounds).
+    Inert (no files, no listener, no threads) when telemetry is
+    disabled — the run then leaves only the journal behind and
+    ``repic-tpu report`` degrades to journal-only tallies.  Probe
+    counters and the registry are baselined here so the run's sinks
+    report THIS run's numbers even when many runs share one process
+    (iterative rounds).
+
+    ``host`` switches to the per-host artifact names
+    (``_events.<host>.jsonl`` / ``_metrics.<host>.json``) — cluster
+    runs share ``out_dir``, so per-host processes must never write
+    one file.  ``flush_interval_s`` overrides the streaming-flush
+    period (env ``REPIC_TPU_FLUSH_S``, default 10 s; <= 0 disables
+    the background flusher — :func:`flush_run` still works).
     """
     if not metrics.enabled():
-        return RunTelemetry(out_dir, None, None)
+        return RunTelemetry(out_dir, None, None, host=host)
     probes.install()
+    ev_name = events.host_events_name(host) if host else events.EVENTS_NAME
     log = events.EventLog(
-        os.path.join(out_dir, events.EVENTS_NAME), run_id=run_id
+        os.path.join(out_dir, ev_name), run_id=run_id
     )
     prev = events.set_current_log(log)
-    return RunTelemetry(
+    rt = RunTelemetry(
         out_dir,
         log,
         prev,
         probes0=probes.snapshot(sample_memory=False),
         registry0=metrics.get_registry().as_dict(),
+        host=host,
     )
+    # breadcrumb for report's device-time section: a profiler trace
+    # opened BEFORE the run scope (the CLI wraps the whole run in
+    # trace_session) would otherwise never reach the event stream
+    from repic_tpu.utils import tracing as _tracing
+
+    trace_dir = _tracing.active_trace_dir()
+    if trace_dir:
+        events.event("trace_dir", path=trace_dir)
+    interval = (
+        _flush_interval()
+        if flush_interval_s is None
+        else flush_interval_s
+    )
+    if interval and interval > 0:
+        rt._flush_stop = threading.Event()
+
+        def _flush_loop():
+            while not rt._flush_stop.wait(interval):
+                try:
+                    flush_run(rt)
+                except Exception:  # noqa: BLE001 - never kill the run
+                    pass
+
+        rt._flusher = threading.Thread(
+            target=_flush_loop,
+            daemon=True,
+            name="repic-tpu-telemetry-flush",
+        )
+        rt._flusher.start()
+    return rt
+
+
+def _write_sinks(rt: RunTelemetry, sample_memory: bool) -> None:
+    """Publish probe deltas and atomically (re)write both snapshots.
+
+    Streaming flushes pass ``sample_memory=False``: the live-buffer
+    walk is O(live arrays) and unsafe to run from the flusher thread
+    (a scan racing the main thread degrades to zeros) — only the
+    final ``finish_run`` samples memory.
+    """
+    probes.publish(baseline=rt.probes0, sample_memory=sample_memory)
+    reg = metrics.get_registry()
+    per_run = metrics.diff_snapshots(reg.as_dict(), rt.registry0 or {})
+    sinks.write_metrics_json(rt.json_path, data=per_run)
+    sinks.write_prometheus_textfile(rt.prom_path, data=per_run)
+
+
+def flush_run(rt: RunTelemetry | None) -> None:
+    """Streaming flush: rewrite the metric sinks mid-run.
+
+    Called by the background flusher on its interval and by the
+    consensus pipeline at every chunk boundary, so a scrape (or an
+    operator ``cat``) during a long run sees current numbers.  Writes
+    are atomic — a reader gets the previous complete snapshot or the
+    new one, never a torn file.  No-op once the run finished (or when
+    telemetry is disabled).
+    """
+    if rt is None or rt.log is None or rt.finished:
+        return
+    with rt._lock:
+        if rt.finished:
+            return
+        _write_sinks(rt, sample_memory=False)
 
 
 def finish_run(rt: RunTelemetry | None) -> None:
     """Publish probe deltas and write the metric sinks (idempotent).
 
     Safe to call from a ``finally``: a run that raised still restores
-    the previous event log, closes the file, and writes the sinks
-    (its partial numbers are exactly what post-mortem triage wants).
+    the previous event log, closes the file, stops the streaming
+    flusher, and writes the sinks (its partial numbers are exactly
+    what post-mortem triage wants).
     """
     if rt is None or rt.finished:
         return
-    rt.finished = True
-    if rt.log is None:
-        return
-    events.set_current_log(rt.prev)
-    rt.log.close()
-    probes.publish(baseline=rt.probes0)
-    reg = metrics.get_registry()
-    per_run = metrics.diff_snapshots(reg.as_dict(), rt.registry0 or {})
-    sinks.write_metrics_json(
-        os.path.join(rt.out_dir, sinks.METRICS_JSON_NAME),
-        data=per_run,
-    )
-    sinks.write_prometheus_textfile(
-        os.path.join(rt.out_dir, sinks.METRICS_PROM_NAME),
-        data=per_run,
-    )
+    if rt._flush_stop is not None:
+        rt._flush_stop.set()
+    if rt._flusher is not None:
+        rt._flusher.join(timeout=5.0)
+    with rt._lock:
+        if rt.finished:
+            return
+        rt.finished = True
+        if rt.log is None:
+            return
+        events.set_current_log(rt.prev)
+        rt.log.close()
+        _write_sinks(rt, sample_memory=True)

@@ -1,0 +1,226 @@
+"""Device-time attribution: where does device time actually go?
+
+``repic-tpu report`` historically showed wall-clock percentiles only,
+so "the pipeline is dispatch/RTT-bound" stayed a diagnosis from one
+round-5 log instead of a first-class metric.  The mega-kernel work
+(ROADMAP item 3, in the spirit of MPK, arXiv:2512.22219) needs the
+split measured per stage and per capacity bucket.  Two host-only
+sources, both jax-free (report runs on login nodes):
+
+* **Span sync stats** (``--device-time``): spans bracket their
+  sections with device syncs (:func:`repic_tpu.telemetry.probes
+  .sync_device`), so each span record carries ``host_s`` (host wall
+  time until span end) and ``device_tail_s`` (device work still
+  executing at that point).  :func:`span_device_time` aggregates
+  them per stage and — for ``consensus_chunk`` spans, which carry a
+  ``capacity`` attribute — per padded capacity bucket, and derives a
+  dispatch-gap estimate.
+* **Profiler traces** (``--trace-dir``): :func:`parse_trace_dir`
+  summarizes the Chrome-trace JSON that ``jax.profiler.trace``
+  writes, giving true device busy time vs. trace wall time.
+  Best-effort: trace layout is an implementation detail of
+  jax/TensorBoard, so any parse failure degrades to ``{}`` — the
+  standard probe contract.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+
+
+def _acc(table: dict, key, rec: dict) -> None:
+    slot = table.setdefault(
+        key, {"count": 0, "host_s": 0.0, "device_tail_s": 0.0}
+    )
+    slot["count"] += 1
+    slot["host_s"] += float(rec.get("host_s", 0.0))
+    slot["device_tail_s"] += float(rec.get("device_tail_s", 0.0))
+
+
+def _finalize(slot: dict) -> dict:
+    total = slot["host_s"] + slot["device_tail_s"]
+    return {
+        "count": slot["count"],
+        "host_s": round(slot["host_s"], 6),
+        "device_tail_s": round(slot["device_tail_s"], 6),
+        "device_frac": round(
+            slot["device_tail_s"] / total if total > 0 else 0.0, 4
+        ),
+    }
+
+
+def span_device_time(records) -> dict:
+    """Aggregate the ``--device-time`` span fields of an event stream.
+
+    Returns ``{}`` when no span carries the device-time fields (the
+    run was not device-timed).  Otherwise::
+
+        {"stages": {name: {count, host_s, device_tail_s,
+                           device_frac}},
+         "by_capacity": {capacity: {...}},   # consensus_chunk spans
+         "dispatch_gap_s": float}            # see below
+
+    ``dispatch_gap_s`` estimates host-side stall while the device
+    program is being driven, accumulated PER SPAN (``max(host_s -
+    device_tail_s, 0)`` each) so a device-saturated span cannot
+    cancel out a dispatch-bound span's stall.  It is computed from
+    the ``consensus_dispatch`` spans, which close right after the
+    async dispatch — their ``host_s`` is pure host trace/dispatch
+    work and their ``device_tail_s`` the batch's device execution
+    (the ``consensus_chunk`` span would be useless here: it contains
+    the blocking result fetch, which drains the device before span
+    exit, so its tail is ~0 by construction).  Saturated device ->
+    every term ~0; dispatch/RTT-bound -> terms approach the dispatch
+    wall times.  An upper bound — host work overlapping device
+    execution counts toward it — refined by the profiler-trace
+    numbers when ``--trace-dir`` was also used.  Streams without
+    dispatch spans fall back to the chunk spans.
+    """
+    stages: dict = {}
+    by_cap: dict = {"consensus_dispatch": {}, "consensus_chunk": {}}
+    gaps = {"consensus_dispatch": None, "consensus_chunk": None}
+    timed = False
+    for rec in records:
+        if rec.get("ev") != "span" or "device_tail_s" not in rec:
+            continue
+        timed = True
+        name = rec.get("name", "?")
+        _acc(stages, name, rec)
+        if name in gaps:
+            gaps[name] = (gaps[name] or 0.0) + max(
+                float(rec.get("host_s", 0.0))
+                - float(rec.get("device_tail_s", 0.0)),
+                0.0,
+            )
+            cap = rec.get("capacity")
+            if cap is not None:
+                _acc(by_cap[name], int(cap), rec)
+    if not timed:
+        return {}
+    out = {
+        "stages": {
+            name: _finalize(slot)
+            for name, slot in sorted(stages.items())
+        },
+    }
+    by_capacity = (
+        by_cap["consensus_dispatch"] or by_cap["consensus_chunk"]
+    )
+    if by_capacity:
+        out["by_capacity"] = {
+            cap: _finalize(slot)
+            for cap, slot in sorted(by_capacity.items())
+        }
+    gap = (
+        gaps["consensus_dispatch"]
+        if gaps["consensus_dispatch"] is not None
+        else gaps["consensus_chunk"]
+    )
+    if gap is not None:
+        out["dispatch_gap_s"] = round(gap, 6)
+    return out
+
+
+# device-lane detection in the Chrome trace process names
+# jax.profiler/TensorBoard emit ("/device:TPU:0", "TPU:0 (pid 4)",
+# "GPU:0", ...).  Word-boundary match on tpu/gpu — a bare substring
+# test would classify host lanes whose names merely CONTAIN the
+# letters (a "repic_tpu worker" pool, a "tpu_driver callback"
+# thread) as device busy time, corrupting the trace-derived gap.
+_DEVICE_LANE_RE = re.compile(
+    r"/device:|(?<![a-z0-9_])(tpu|gpu)(?![a-z0-9_])"
+)
+
+
+def parse_trace_dir(trace_dir: str) -> dict:
+    """Best-effort summary of a ``jax.profiler.trace`` directory.
+
+    Finds every Chrome-trace JSON (``*.trace.json[.gz]`` under the
+    TensorBoard ``plugins/profile/<run>/`` layout), classifies trace
+    lanes into device vs. host by process name, and returns::
+
+        {"wall_s", "device_busy_s", "host_busy_s", "device_ops",
+         "dispatch_gap_s", "files"}
+
+    ``device_busy_s`` sums complete-event durations on device lanes
+    (overlap between device lanes is not deduplicated — an upper
+    bound on a multi-stream device, exact on one stream);
+    ``dispatch_gap_s = wall_s - device_busy_s`` (floored at 0) is the
+    trace-derived idle-device estimate.  Any missing/unparseable
+    artifact degrades to ``{}`` — never an error, the trace format is
+    not this project's contract.
+    """
+    pattern = os.path.join(trace_dir, "**", "*.trace.json*")
+    paths = [
+        p
+        for p in sorted(glob.glob(pattern, recursive=True))
+        if p.endswith((".trace.json", ".trace.json.gz"))
+    ]
+    trace_events: list[dict] = []
+    used_files = []
+    for path in paths:
+        opener = gzip.open if path.endswith(".gz") else open
+        try:
+            with opener(path, "rt") as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(data, dict):
+            evs = data.get("traceEvents", [])
+        elif isinstance(data, list):  # bare event-array variant
+            evs = data
+        else:
+            continue
+        if evs:
+            trace_events.extend(e for e in evs if isinstance(e, dict))
+            used_files.append(os.path.relpath(path, trace_dir))
+    if not trace_events:
+        return {}
+
+    pid_names: dict = {}
+    for e in trace_events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e.get("pid")] = str(
+                (e.get("args") or {}).get("name", "")
+            )
+
+    def _is_device(pid) -> bool:
+        return bool(
+            _DEVICE_LANE_RE.search(pid_names.get(pid, "").lower())
+        )
+
+    t_min, t_max = None, None
+    device_us = 0.0
+    host_us = 0.0
+    device_ops = 0
+    for e in trace_events:
+        if e.get("ph") != "X":
+            continue
+        try:
+            ts = float(e.get("ts", 0.0))
+            dur = float(e.get("dur", 0.0))
+        except (TypeError, ValueError):
+            continue
+        t_min = ts if t_min is None else min(t_min, ts)
+        t_max = ts + dur if t_max is None else max(t_max, ts + dur)
+        if _is_device(e.get("pid")):
+            device_us += dur
+            device_ops += 1
+        else:
+            host_us += dur
+    if t_min is None:
+        return {}
+    wall_s = (t_max - t_min) / 1e6
+    device_busy_s = device_us / 1e6
+    return {
+        "wall_s": round(wall_s, 6),
+        "device_busy_s": round(device_busy_s, 6),
+        "host_busy_s": round(host_us / 1e6, 6),
+        "device_ops": device_ops,
+        "dispatch_gap_s": round(max(wall_s - device_busy_s, 0.0), 6),
+        "files": used_files,
+    }

@@ -43,6 +43,28 @@ from repic_tpu.telemetry import metrics, probes
 
 EVENTS_NAME = "_events.jsonl"
 
+
+def host_events_name(host: str) -> str:
+    """Per-host event log file name (cluster runs): each host appends
+    to its OWN ``_events.<host>.jsonl`` — the same single-writer
+    scheme as the per-host journals, so concurrent hosts sharing one
+    run directory never interleave (or clobber) each other's
+    records."""
+    from repic_tpu.runtime.journal import sanitize_host_id
+
+    return f"_events.{sanitize_host_id(host)}.jsonl"
+
+
+def events_paths(out_dir: str) -> list[str]:
+    """Every event log of a run: the single-process ``_events.jsonl``
+    plus any per-host ``_events.<host>.jsonl``, in sorted order."""
+    from repic_tpu.runtime.journal import host_artifact_paths
+
+    return [
+        path
+        for _, path in host_artifact_paths(out_dir, EVENTS_NAME)
+    ]
+
 # per-thread/ctx stack of open span ids (parent linkage)
 _SPAN_STACK: contextvars.ContextVar[tuple] = contextvars.ContextVar(
     "repic_tpu_span_stack", default=()
@@ -123,13 +145,28 @@ class _Span:
         self.parent_id = stack[-1] if stack else None
         self.span_id = next(_SPAN_IDS)
         self._token = _SPAN_STACK.set(stack + (self.span_id,))
+        if probes.device_time_enabled():
+            # drain device work queued BEFORE this span so an earlier
+            # stage's async tail is not attributed to this one
+            probes.sync_device()
         self._c0 = probes.counters()
         self._wall0 = time.time()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb):
-        dur = time.perf_counter() - self._t0
+        host_dur = time.perf_counter() - self._t0
+        # Device-time attribution (opt-in, --device-time): block until
+        # the device drained, splitting the span into the host-side
+        # wall time and the device tail still executing when the host
+        # reached span end.  Serializes stages by design — attribution
+        # mode trades overlap for an exact split.
+        tail = (
+            probes.sync_device()
+            if probes.device_time_enabled()
+            else None
+        )
+        dur = host_dur if tail is None else host_dur + tail
         _SPAN_STACK.reset(self._token)
         _SPAN_SECONDS.observe(dur, name=self.name)
         log = _CURRENT_LOG
@@ -149,6 +186,9 @@ class _Span:
             if c1[1] != self._c0[1]:
                 rec["transfer_bytes"] = c1[1] - self._c0[1]
                 rec["transfer_fetches"] = c1[2] - self._c0[2]
+            if tail is not None:
+                rec["host_s"] = round(host_dur, 6)
+                rec["device_tail_s"] = round(tail, 6)
             if exc_type is not None:
                 rec["error"] = exc_type.__name__
             rec.update(self.attrs)
@@ -254,20 +294,35 @@ def get_logger(name: str) -> StructuredLogger:
 
 
 def read_events(path_or_dir: str) -> list[dict]:
-    """All records of an event log (torn trailing lines skipped)."""
-    path = path_or_dir
-    if os.path.isdir(path):
-        path = os.path.join(path, EVENTS_NAME)
-    records = []
-    if not os.path.exists(path):
+    """All records of a run's event log(s).
+
+    Given a directory, merges the single-process ``_events.jsonl``
+    with every per-host ``_events.<host>.jsonl`` (cluster runs) in
+    wall-clock order; given a file path, reads just that file.
+
+    Torn-tail parity with :func:`repic_tpu.runtime.journal._read_entries`:
+    a crash mid-append leaves a torn trailing line, and a file deleted
+    between glob and open raises ``OSError`` — both are tolerated,
+    because the post-crash run directory is exactly what
+    ``repic-tpu report`` gets pointed at.
+    """
+    if os.path.isdir(path_or_dir):
+        per_file = [
+            _read_event_file(p) for p in events_paths(path_or_dir)
+        ]
+        if len(per_file) <= 1:
+            return per_file[0] if per_file else []
+        records = [rec for recs in per_file for rec in recs]
+        # stable sort: records with equal stamps keep per-file
+        # (append) order
+        records.sort(key=lambda r: float(r.get("t", 0.0)))
         return records
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                records.append(json.loads(line))
-            except ValueError:
-                continue  # torn trailing line from a crash
-    return records
+    return _read_event_file(path_or_dir)
+
+
+def _read_event_file(path: str) -> list[dict]:
+    # the journal's reader IS the torn-tail/OSError tolerance
+    # contract — share it rather than keeping a copy that can drift
+    from repic_tpu.runtime.journal import _read_entries
+
+    return _read_entries(path)

@@ -33,11 +33,87 @@ disabled).
 
 from __future__ import annotations
 
+import contextlib
 import threading
+import time
 
 _lock = threading.Lock()
 _installed = False
 _install_failed = False
+
+# Device-time attribution mode (opt-in via --device-time): spans
+# bracket their sections with device syncs so the event stream splits
+# every stage into host wall time vs the device tail still executing
+# when the host reached span end.  A plain module bool — read once
+# per span boundary, so disabled mode costs one global load.
+_device_time = False
+
+
+def set_device_time(flag: bool) -> None:
+    """Enable/disable device-sync span bracketing (``--device-time``)."""
+    global _device_time
+    _device_time = bool(flag)
+
+
+def device_time_enabled() -> bool:
+    return _device_time
+
+
+def sync_device() -> float:
+    """Block until the devices drained; returns seconds spent waiting.
+
+    Sync ladder: the per-device ``synchronize_all_activity`` over
+    EVERY local device when the backend exposes it (a meshed run
+    keeps all of them busy — syncing only device 0 would
+    under-report the tail and inflate the dispatch-gap estimate);
+    otherwise block on every live array.  ``jax.effects_barrier()``
+    is deliberately NOT a rung — it waits on effect *tokens* only,
+    not pending pure async computations (measured: 0 ms reported
+    while >1 s of dispatched matmuls were still executing), which
+    would make the whole attribution read as host time.  Blocking on
+    ``jax.live_arrays()`` is the portable drain: already-ready
+    arrays return immediately, in-flight outputs of the dispatched
+    program block until done.  O(live arrays) — acceptable for an
+    opt-in measurement mode.  Degrades to a 0.0-cost no-op when jax
+    is unavailable — the same contract as the other probes.
+    """
+    t0 = time.perf_counter()
+    try:
+        import jax
+
+        synced = False
+        for dev in jax.local_devices():
+            sync = getattr(dev, "synchronize_all_activity", None)
+            if sync is None:
+                break
+            sync()
+            synced = True
+        if not synced:
+            for arr in jax.live_arrays():
+                try:
+                    arr.block_until_ready()
+                except Exception:  # deleted/donated mid-walk
+                    continue
+    except Exception:  # pragma: no cover - degraded environments
+        return 0.0
+    return time.perf_counter() - t0
+
+
+@contextlib.contextmanager
+def device_time(enabled: bool):
+    """Scoped attribution mode for CLI mains: ``set_device_time`` is
+    a process-wide latch, so entry points restore the previous value
+    on the way out — one device-timed run must not leave every later
+    in-process run paying span-boundary syncs."""
+    if not enabled:
+        yield
+        return
+    prev = _device_time
+    set_device_time(True)
+    try:
+        yield
+    finally:
+        set_device_time(prev)
 
 # authoritative cumulative totals (module ints: listener + fetch
 # sites bump these; the registry mirrors them at publish() time)
@@ -153,7 +229,8 @@ def snapshot(sample_memory: bool = True) -> dict:
     return out
 
 
-def publish(registry=None, baseline: dict | None = None) -> dict:
+def publish(registry=None, baseline: dict | None = None,
+            sample_memory: bool = True) -> dict:
     """Mirror the probe totals into the metrics registry as gauges.
 
     Returns the snapshot it published.  Gauges (not counters): the
@@ -163,11 +240,19 @@ def publish(registry=None, baseline: dict | None = None) -> dict:
     published as deltas — a run's sinks then report THAT run's
     recompiles/transfers, not the process lifetime's (an iterative
     pipeline runs many consensus rounds in one process).
+
+    ``sample_memory=False`` skips the live-buffer walk and allocator
+    stats (and leaves their gauges untouched): streaming flushes run
+    per chunk and from a background thread, where an O(live-arrays)
+    ``jax.live_arrays()`` scan is hot-path cost — and a scan racing
+    the main thread degrades to (0, 0), which would overwrite real
+    values with zeros mid-run.  The cheap counter totals are always
+    published.
     """
     from repic_tpu.telemetry import metrics as _metrics
 
     reg = registry or _metrics.get_registry()
-    snap = snapshot()
+    snap = snapshot(sample_memory=sample_memory)
     if baseline:
         for key in (
             "recompiles",
@@ -192,20 +277,22 @@ def publish(registry=None, baseline: dict | None = None) -> dict:
         "repic_transfer_fetches_total",
         "host<->device round trips at instrumented fetch sites",
     ).set(snap["transfer_fetches"])
-    reg.gauge(
-        "repic_live_buffer_count", "live device arrays at publish"
-    ).set(snap.get("live_buffer_count", 0))
-    reg.gauge(
-        "repic_live_buffer_bytes", "live device array bytes at publish"
-    ).set(snap.get("live_buffer_bytes", 0))
-    mem = snap.get("device_memory", {})
-    if mem:
-        g = reg.gauge(
-            "repic_device_memory_bytes",
-            "allocator stats of device 0 (absent on CPU)",
-        )
-        for key, val in mem.items():
-            g.set(val, stat=key)
+    if sample_memory:
+        reg.gauge(
+            "repic_live_buffer_count", "live device arrays at publish"
+        ).set(snap.get("live_buffer_count", 0))
+        reg.gauge(
+            "repic_live_buffer_bytes",
+            "live device array bytes at publish",
+        ).set(snap.get("live_buffer_bytes", 0))
+        mem = snap.get("device_memory", {})
+        if mem:
+            g = reg.gauge(
+                "repic_device_memory_bytes",
+                "allocator stats of device 0 (absent on CPU)",
+            )
+            for key, val in mem.items():
+                g.set(val, stat=key)
     return snap
 
 

@@ -24,8 +24,14 @@ import json
 import math
 import os
 
+from repic_tpu.telemetry import devicetime as _devicetime
 from repic_tpu.telemetry import events as _events
 from repic_tpu.telemetry import sinks as _sinks
+
+#: version of the ``repic-tpu report --json`` field contract
+#: (docs/observability.md "Report JSON contract").  Bump on any
+#: breaking change to existing fields; additive sections don't bump.
+SCHEMA_VERSION = 2
 
 
 def _percentile(values: list[float], q: float) -> float:
@@ -57,6 +63,19 @@ def _gauge_value(metrics: dict, name: str):
         if not sample.get("labels"):
             return sample.get("value")
     return None
+
+
+def _gauge_total(metrics_by_host: dict, name: str):
+    """Sum a gauge over every host's snapshot (cluster runs write one
+    ``_metrics.<host>.json`` each; the probe gauges are per-run
+    totals, so the cluster figure is their sum).  ``None`` when no
+    snapshot carries the gauge — callers then fall back to span
+    deltas."""
+    values = [
+        _gauge_value(m, name) for m in metrics_by_host.values()
+    ]
+    values = [v for v in values if v is not None]
+    return sum(values) if values else None
 
 
 def _read_runtime_tsv(run_dir: str) -> dict:
@@ -96,7 +115,9 @@ def build_report(run_dir: str) -> dict:
 
     journal = read_all_journals(run_dir)
     records = _events.read_events(run_dir)
-    metrics = _sinks.read_metrics_json(run_dir)
+    # every metrics snapshot: the single-process _metrics.json plus
+    # any per-host _metrics.<host>.json a cluster run left behind
+    metrics_by_host = _sinks.read_all_metrics_json(run_dir)
 
     # -- journal: per-micrograph outcomes ----------------------------
     latest: dict[str, dict] = {}
@@ -186,11 +207,14 @@ def build_report(run_dir: str) -> dict:
         for name, durs in sorted(stage_durs.items())
     }
 
-    # -- device probes: metrics snapshot, span deltas as fallback ----
-    recompiles = _gauge_value(metrics, "repic_recompiles_total")
-    transfer_bytes = _gauge_value(metrics, "repic_transfer_bytes_total")
-    transfer_fetches = _gauge_value(
-        metrics, "repic_transfer_fetches_total"
+    # -- device probes: metrics snapshots (summed over hosts), span
+    #    deltas as fallback ------------------------------------------
+    recompiles = _gauge_total(metrics_by_host, "repic_recompiles_total")
+    transfer_bytes = _gauge_total(
+        metrics_by_host, "repic_transfer_bytes_total"
+    )
+    transfer_fetches = _gauge_total(
+        metrics_by_host, "repic_transfer_fetches_total"
     )
     device = {
         "recompiles": int(
@@ -207,11 +231,34 @@ def build_report(run_dir: str) -> dict:
             else span_transfer_fetches
         ),
     }
-    compile_s = _gauge_value(metrics, "repic_compile_seconds_total")
+    compile_s = _gauge_total(
+        metrics_by_host, "repic_compile_seconds_total"
+    )
     if compile_s is not None:
         device["compile_seconds"] = round(float(compile_s), 3)
 
+    # -- device-time attribution (--device-time / --trace-dir) -------
+    device_time = _devicetime.span_device_time(records)
+    trace_paths = [
+        str(rec["path"])
+        for rec in records
+        if rec.get("ev") == "event"
+        and rec.get("name") == "trace_dir"
+        and rec.get("path")
+    ]
+    # LAST breadcrumb wins: the run log appends across re-runs /
+    # resumes into the same directory, and the trace numbers must
+    # describe the same execution the span stats do
+    for path in reversed(trace_paths):
+        if not os.path.isdir(path):
+            continue
+        trace = _devicetime.parse_trace_dir(path)
+        if trace:
+            device_time["trace"] = trace
+            break
+
     report = {
+        "schema_version": SCHEMA_VERSION,
         "run_dir": os.path.abspath(run_dir),
         "run_id": run_id,
         "micrographs": {
@@ -234,10 +281,30 @@ def build_report(run_dir: str) -> dict:
         "device": device,
         "runtime_tsv": _read_runtime_tsv(run_dir),
     }
+    if device_time:
+        report["device_time"] = device_time
     if clustered:
         cluster["hosts"] = dict(sorted(cluster["hosts"].items()))
         cluster["suspects"] = len(suspect_hosts)
         cluster["fences"] = len(fenced_hosts)
+        # per-host device totals from the per-host metric snapshots
+        telemetry_by_host = {}
+        for host, m in sorted(metrics_by_host.items()):
+            if host is None:
+                continue
+            row = {}
+            for field, gauge in (
+                ("recompiles", "repic_recompiles_total"),
+                ("transfer_bytes", "repic_transfer_bytes_total"),
+                ("transfer_fetches", "repic_transfer_fetches_total"),
+            ):
+                v = _gauge_value(m, gauge)
+                if v is not None:
+                    row[field] = int(v)
+            if row:
+                telemetry_by_host[host] = row
+        if telemetry_by_host:
+            cluster["telemetry"] = telemetry_by_host
         report["cluster"] = cluster
     return report
 
@@ -340,6 +407,34 @@ def format_report(report: dict) -> str:
     if "compile_seconds" in dev:
         dev_line += f" compile_time={dev['compile_seconds']:.1f}s"
     lines.append(dev_line)
+
+    dt = report.get("device_time")
+    if dt:
+        lines.append("device time (host vs device tail, s):")
+        for name, st in dt.get("stages", {}).items():
+            lines.append(
+                f"  {name}: host={st['host_s']:.3f} "
+                f"device_tail={st['device_tail_s']:.3f} "
+                f"(device_frac={st['device_frac']:.2f})"
+            )
+        for cap, st in dt.get("by_capacity", {}).items():
+            lines.append(
+                f"  capacity {cap}: host={st['host_s']:.3f} "
+                f"device_tail={st['device_tail_s']:.3f} "
+                f"over {st['count']} chunk(s)"
+            )
+        if "dispatch_gap_s" in dt:
+            lines.append(
+                f"  dispatch gap (est): {dt['dispatch_gap_s']:.3f}s"
+            )
+        tr = dt.get("trace")
+        if tr:
+            lines.append(
+                f"  profiler trace: device_busy={tr['device_busy_s']:.3f}s"
+                f" of {tr['wall_s']:.3f}s wall "
+                f"({tr['device_ops']} device op(s), "
+                f"gap={tr['dispatch_gap_s']:.3f}s)"
+            )
 
     if report["runtime_tsv"]:
         stages = " ".join(

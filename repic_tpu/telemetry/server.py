@@ -1,0 +1,216 @@
+"""In-process status server: ``/metrics``, ``/status``, ``/healthz``.
+
+The textfile sink (:mod:`repic_tpu.telemetry.sinks`) covers batch
+jobs; a long-lived consensus service needs the other standard
+surface — an HTTP endpoint a scrape-based monitor (or an operator's
+``curl``) hits WHILE the run is live.  This is the seed of the
+``serve`` daemon's SLO surface (ROADMAP item 1), modeled on the
+separable monitoring/coordination layer of the TensorFlow system
+paper (arXiv:1605.08695): the dataflow core never blocks on it.
+
+* ``/metrics`` — Prometheus exposition of the LIVE registry
+  (:func:`repic_tpu.telemetry.sinks.render_prometheus`), not a file
+  snapshot: every counter/histogram the pipeline bumped an instant
+  ago is visible.
+* ``/status`` — one JSON document: run id, chunk progress,
+  ladder/quarantine tallies (pushed by the pipeline via
+  :func:`set_status`), plus a cluster liveness view computed on
+  request from the coordination directory
+  (:func:`repic_tpu.runtime.cluster.read_liveness`).
+* ``/healthz`` — liveness probe (200 ``ok``).
+
+Off by default; the consensus CLI enables it with ``--status-port``
+(port 0 binds an ephemeral port).  Binds 127.0.0.1 only — exposure
+beyond the host is a deployment concern (SSH tunnel, sidecar proxy),
+not this module's.  When no server is running the whole surface is
+inert: :func:`set_status` is one global load and a branch, and
+nothing is imported, bound, or spawned (the PR 3 disabled-mode
+contract).  Requests are served from a stdlib ``ThreadingHTTPServer``
+in a daemon thread; the registry snapshot it reads is lock-protected,
+so a scrape never torn-reads a histogram.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+
+from repic_tpu.telemetry import metrics as _metrics
+
+_ACTIVE: "StatusServer | None" = None
+_STATUS: dict = {}
+_STATUS_LOCK = threading.Lock()
+
+
+def set_status(**fields) -> None:
+    """Merge fields into the ``/status`` document.
+
+    Near-zero overhead when no server is running (one global load and
+    a branch) — the pipeline calls this per chunk unconditionally.
+    """
+    if _ACTIVE is None:
+        return
+    with _STATUS_LOCK:
+        _STATUS.update(fields)
+
+
+def get_status() -> dict:
+    with _STATUS_LOCK:
+        return dict(_STATUS)
+
+
+def active_server() -> "StatusServer | None":
+    return _ACTIVE
+
+
+class StatusServer:
+    """One HTTP endpoint in a daemon thread; start()/stop() or use as
+    a context manager.  ``port=0`` binds an ephemeral port — read the
+    bound port from ``self.port`` after :meth:`start`."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry=None):
+        self.host = host
+        self.requested_port = int(port)
+        self.port: int | None = None
+        self.registry = registry
+        self._httpd = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "StatusServer":
+        global _ACTIVE
+        import http.server  # lazy: the module is inert unless served
+
+        registry = self.registry or _metrics.get_registry()
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - http.server protocol
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    self._send(
+                        200, "text/plain; charset=utf-8", "ok\n"
+                    )
+                elif path == "/metrics":
+                    from repic_tpu.telemetry import sinks
+
+                    self._send(
+                        200,
+                        "text/plain; version=0.0.4; charset=utf-8",
+                        sinks.render_prometheus(registry.as_dict()),
+                    )
+                elif path == "/status":
+                    self._send(
+                        200,
+                        "application/json",
+                        json.dumps(
+                            server.status_document(),
+                            default=str,
+                            sort_keys=True,
+                        )
+                        + "\n",
+                    )
+                else:
+                    self._send(
+                        404, "text/plain; charset=utf-8",
+                        "not found (try /metrics, /status, /healthz)\n",
+                    )
+
+            def _send(self, code: int, ctype: str, body: str):
+                data = body.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args):  # no per-request stderr spam
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self.host, self.requested_port), Handler
+        )
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.25},
+            daemon=True,
+            name="repic-tpu-status",
+        )
+        self._thread.start()
+        _ACTIVE = self
+        return self
+
+    def stop(self) -> None:
+        global _ACTIVE
+        if _ACTIVE is self:
+            _ACTIVE = None
+            with _STATUS_LOCK:
+                _STATUS.clear()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def status_document(self) -> dict:
+        """The ``/status`` JSON: pushed fields plus a liveness view
+        computed per request when the run registered cluster info."""
+        doc = get_status()
+        doc["ts"] = time.time()
+        cluster = doc.get("cluster")
+        if isinstance(cluster, dict) and cluster.get(
+            "coordination_dir"
+        ):
+            try:
+                from repic_tpu.runtime.cluster import read_liveness
+
+                view = read_liveness(
+                    cluster["coordination_dir"],
+                    float(cluster.get("host_timeout_s", 10.0)),
+                )
+                doc["cluster"] = dict(
+                    cluster,
+                    hosts={
+                        h: {
+                            "rung": s.rung,
+                            "age_s": s.age_s,
+                            "lease": len(s.lease_names),
+                        }
+                        for h, s in view.items()
+                    },
+                )
+            except Exception:  # noqa: BLE001 - scrape never raises
+                pass
+        return doc
+
+    def __enter__(self) -> "StatusServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+@contextlib.contextmanager
+def maybe_status_server(port: int | None):
+    """CLI helper: a running server when ``port`` is set, else a pure
+    no-op (nothing bound, nothing spawned — zero overhead)."""
+    if port is None:
+        yield None
+        return
+    try:
+        srv = StatusServer(port).start()
+    except OSError as e:
+        # fail fast and readable — before the run touches anything
+        raise SystemExit(
+            f"repic-tpu: --status-port {port}: cannot bind ({e})"
+        ) from e
+    try:
+        yield srv
+    finally:
+        srv.stop()

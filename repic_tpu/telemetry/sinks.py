@@ -30,6 +30,41 @@ METRICS_JSON_NAME = "_metrics.json"
 METRICS_PROM_NAME = "_metrics.prom"
 
 
+def host_metrics_json_name(host: str) -> str:
+    """Per-host JSON snapshot name (cluster runs): mirrors the
+    ``_journal.<host>.jsonl`` scheme so per-host processes sharing one
+    run directory never clobber each other's snapshot."""
+    from repic_tpu.runtime.journal import sanitize_host_id
+
+    return f"_metrics.{sanitize_host_id(host)}.json"
+
+
+def host_metrics_prom_name(host: str) -> str:
+    from repic_tpu.runtime.journal import sanitize_host_id
+
+    return f"_metrics.{sanitize_host_id(host)}.prom"
+
+
+def metrics_json_paths(out_dir: str) -> list[tuple[str | None, str]]:
+    """``(host, path)`` for every metrics snapshot of a run — the
+    single-process ``_metrics.json`` (host ``None``) plus any per-host
+    ``_metrics.<host>.json``, hosts sorted."""
+    from repic_tpu.runtime.journal import host_artifact_paths
+
+    return host_artifact_paths(out_dir, METRICS_JSON_NAME)
+
+
+def read_all_metrics_json(out_dir: str) -> dict:
+    """``{host_or_None: metrics-mapping}`` over every snapshot of a
+    run directory.  Cluster runs produce one snapshot per host;
+    ``repic-tpu report`` sums the per-host device totals and keeps the
+    per-host breakdown in its cluster section."""
+    return {
+        host: read_metrics_json(path)
+        for host, path in metrics_json_paths(out_dir)
+    }
+
+
 def write_metrics_json(path: str, registry=None, data=None) -> str:
     """Snapshot the registry as one JSON document; returns ``path``.
 
@@ -82,18 +117,16 @@ def _fmt(value) -> str:
     return str(int(f)) if f == int(f) else repr(f)
 
 
-def write_prometheus_textfile(path: str, registry=None,
-                              data=None) -> str:
-    """Render the registry in Prometheus exposition format.
+def render_prometheus(data: dict) -> str:
+    """Prometheus exposition text for an ``as_dict``-shaped mapping.
 
     Histograms expand to ``_bucket{le=...}`` series with CUMULATIVE
     counts (the stored per-bucket counts are disjoint), plus ``_sum``
     and ``_count``; the terminal ``le="+Inf"`` bucket equals
-    ``_count`` as the format requires.  ``data`` overrides the
-    registry as in :func:`write_metrics_json`.
+    ``_count`` as the format requires.  Shared by the textfile sink
+    and the live ``/metrics`` endpoint
+    (:mod:`repic_tpu.telemetry.server`).
     """
-    if data is None:
-        data = (registry or _metrics.get_registry()).as_dict()
     lines: list[str] = []
     for name, entry in sorted(data.items()):
         lines.append(f"# HELP {name} {entry['help']}")
@@ -128,8 +161,19 @@ def write_prometheus_textfile(path: str, registry=None,
                     f"{name}{_prom_labels(sample['labels'])} "
                     f"{_fmt(sample['value'])}"
                 )
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def write_prometheus_textfile(path: str, registry=None,
+                              data=None) -> str:
+    """Write the registry as a Prometheus textfile
+    (:func:`render_prometheus`); ``data`` overrides the registry as in
+    :func:`write_metrics_json`.
+    """
+    if data is None:
+        data = (registry or _metrics.get_registry()).as_dict()
     with atomic_write(path) as f:
-        f.write("\n".join(lines) + ("\n" if lines else ""))
+        f.write(render_prometheus(data))
     return path
 
 

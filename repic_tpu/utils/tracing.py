@@ -34,6 +34,16 @@ import time
 from dataclasses import dataclass, field
 
 
+# the directory of the profiler trace currently being recorded (if
+# any) — telemetry.start_run drops a `trace_dir` event into the run
+# log so `repic-tpu report` can find and parse the trace afterwards
+_ACTIVE_TRACE_DIR: str | None = None
+
+
+def active_trace_dir() -> str | None:
+    return _ACTIVE_TRACE_DIR
+
+
 @contextlib.contextmanager
 def trace_session(trace_dir: str | None):
     """XLA/device profiler trace (no-op when ``trace_dir`` is None).
@@ -41,16 +51,30 @@ def trace_session(trace_dir: str | None):
     Produces a TensorBoard/Perfetto-compatible trace of every XLA
     launch, transfer, and host event under ``trace_dir`` — the TPU
     equivalent of the profiler integration the reference lacks
-    (SURVEY.md section 5: wall-clock only).
+    (SURVEY.md section 5: wall-clock only).  The active directory is
+    recorded in the telemetry event stream (``trace_dir`` event) so
+    ``repic-tpu report`` can join the trace's device timeline into
+    its device-time section.
     """
+    global _ACTIVE_TRACE_DIR
     if not trace_dir:
         yield
         return
     import jax
 
     os.makedirs(trace_dir, exist_ok=True)
-    with jax.profiler.trace(trace_dir):
-        yield
+    prev = _ACTIVE_TRACE_DIR
+    _ACTIVE_TRACE_DIR = os.path.abspath(trace_dir)
+    from repic_tpu.telemetry import events
+
+    # no-op when no run log is open yet; telemetry.start_run emits
+    # the same breadcrumb for the CLI ordering (trace opened first)
+    events.event("trace_dir", path=_ACTIVE_TRACE_DIR)
+    try:
+        with jax.profiler.trace(trace_dir):
+            yield
+    finally:
+        _ACTIVE_TRACE_DIR = prev
 
 
 @dataclass

@@ -1,0 +1,170 @@
+#!/usr/bin/env python3
+"""Diff two ``BENCH_*.json`` artifacts with a regression threshold.
+
+The repo accumulates benchmark artifacts (``BENCH_r0N.json``,
+``BENCH_TPU_LAST.json``, the fixture smoke-bench) but comparing them
+has been a by-eye exercise.  This script makes the comparison a
+command — and an advisory CI gate::
+
+    python scripts/bench_compare.py BASELINE.json CURRENT.json \
+        [--threshold-pct 10] [--advisory]
+
+Accepts either the driver-wrapper shape (``{"parsed": {...}}``, as
+the round artifacts are written) or a raw measurement row (one
+``bench.py`` stdout line, or ``scripts/bench_fixture.py`` output).
+Three headline fields are compared when both sides carry them:
+
+* ``value``        (micrographs/sec — higher is better)
+* ``warm_total_s`` (steady-state wall — lower is better)
+* ``first_call_s`` (compile-inclusive first call — lower is better)
+
+Exit status: 0 OK / within threshold, 1 regression beyond
+``--threshold-pct`` (0 with ``--advisory``), 2 unusable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# (field, higher_is_better) — compared when present on both sides
+FIELDS = (
+    ("value", True),
+    ("warm_total_s", False),
+    ("first_call_s", False),
+)
+
+
+def load_row(path: str) -> dict:
+    """The measurement row of a BENCH artifact (wrapper or raw)."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    row = data.get("parsed", data)
+    if not isinstance(row, dict):
+        raise ValueError(f"{path}: 'parsed' is not an object")
+    return row
+
+
+def compare(baseline: dict, current: dict,
+            threshold_pct: float) -> tuple[list[dict], list[str]]:
+    """Per-field deltas and the list of regressions beyond threshold.
+
+    ``change_pct`` is signed so that POSITIVE always means better
+    (throughput up, latency down).
+    """
+    rows, regressions = [], []
+    for field, higher_better in FIELDS:
+        base, cur = baseline.get(field), current.get(field)
+        if not isinstance(base, (int, float)) or not isinstance(
+            cur, (int, float)
+        ):
+            continue
+        if base == 0:
+            continue
+        raw_pct = (cur - base) / abs(base) * 100.0
+        change_pct = raw_pct if higher_better else -raw_pct
+        regressed = change_pct < -threshold_pct
+        rows.append(
+            {
+                "field": field,
+                "baseline": base,
+                "current": cur,
+                "change_pct": round(change_pct, 2),
+                "regressed": regressed,
+            }
+        )
+        if regressed:
+            regressions.append(
+                f"{field}: {base:g} -> {cur:g} "
+                f"({change_pct:+.1f}% vs threshold "
+                f"-{threshold_pct:g}%)"
+            )
+    return rows, regressions
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="diff two BENCH_*.json artifacts"
+    )
+    parser.add_argument("baseline", help="baseline BENCH artifact")
+    parser.add_argument("current", help="current BENCH artifact")
+    parser.add_argument(
+        "--threshold-pct",
+        type=float,
+        default=10.0,
+        help="regression tolerance in percent (default 10)",
+    )
+    parser.add_argument(
+        "--advisory",
+        action="store_true",
+        help="report regressions but exit 0 (CI advisory mode)",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the comparison as JSON instead of text",
+    )
+    args = parser.parse_args(argv)
+
+    try:
+        baseline = load_row(args.baseline)
+        current = load_row(args.current)
+    except (OSError, ValueError) as e:
+        print(f"bench_compare: error: {e}", file=sys.stderr)
+        return 2
+
+    rows, regressions = compare(
+        baseline, current, args.threshold_pct
+    )
+    if not rows:
+        print(
+            "bench_compare: error: no comparable fields "
+            f"(need one of {[f for f, _ in FIELDS]} on both sides)",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "metric": current.get(
+                        "metric", baseline.get("metric")
+                    ),
+                    "threshold_pct": args.threshold_pct,
+                    "fields": rows,
+                    "regressions": regressions,
+                    "ok": not regressions,
+                },
+                indent=2,
+            )
+        )
+    else:
+        metric = current.get("metric") or baseline.get("metric")
+        if metric:
+            print(f"metric: {metric}")
+        for r in rows:
+            flag = "  REGRESSION" if r["regressed"] else ""
+            print(
+                f"{r['field']:>14}: {r['baseline']:g} -> "
+                f"{r['current']:g} ({r['change_pct']:+.1f}%){flag}"
+            )
+        if regressions:
+            print(
+                f"{len(regressions)} regression(s) beyond "
+                f"{args.threshold_pct:g}%"
+                + (" [advisory]" if args.advisory else "")
+            )
+        else:
+            print(f"ok (threshold {args.threshold_pct:g}%)")
+
+    if regressions and not args.advisory:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,85 @@
+#!/usr/bin/env python3
+"""Fixture smoke-bench: the mini10017 consensus in BENCH shape.
+
+The full ``bench.py`` needs the EMPIAR-10017 example set and a chip
+lock; CI needs something that finishes in seconds and still exercises
+the real fused pipeline.  This script times ``run_consensus_dir``
+over the committed ``tests/fixtures/mini10017`` set twice — first
+call (compile included) then warm — and prints ONE JSON document in
+the BENCH artifact shape, so ``scripts/bench_compare.py`` can diff it
+against the checked-in baseline
+(``tests/golden/BENCH_fixture_baseline.json``)::
+
+    python scripts/bench_fixture.py > /tmp/bench_fixture.json
+    python scripts/bench_compare.py \
+        tests/golden/BENCH_fixture_baseline.json \
+        /tmp/bench_fixture.json --threshold-pct 50 --advisory
+
+Always CPU (set before the jax import): the point is an
+apples-to-apples host-side smoke number, not a TPU measurement.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# never read/write the user's persisted capacity configs: the smoke
+# number must not depend on what some earlier run recorded
+os.environ.setdefault("REPIC_TPU_NO_CONFIG_CACHE", "1")
+# stdout IS the artifact: silence INFO-level structured-log lines
+# (they print to stdout and would corrupt the JSON document for
+# bench_compare); warnings/errors still reach stderr
+os.environ.setdefault("REPIC_TPU_LOG_LEVEL", "warning")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:  # runnable from a bare checkout, no install
+    sys.path.insert(0, ROOT)
+FIXTURE = os.path.join(ROOT, "tests", "fixtures", "mini10017")
+BOX_SIZE = 180
+
+
+def _one_run(in_dir: str) -> tuple[float, int]:
+    from repic_tpu.pipeline.consensus import run_consensus_dir
+
+    with tempfile.TemporaryDirectory() as out_dir:
+        t0 = time.perf_counter()
+        stats = run_consensus_dir(
+            in_dir,
+            os.path.join(out_dir, "run"),
+            BOX_SIZE,
+            use_mesh=False,
+        )
+        return time.perf_counter() - t0, stats["micrographs"]
+
+
+def main() -> int:
+    if not os.path.isdir(FIXTURE):
+        print(
+            f"bench_fixture: error: fixture not found: {FIXTURE}",
+            file=sys.stderr,
+        )
+        return 2
+    first_call_s, n = _one_run(FIXTURE)
+    warm_total_s, _ = _one_run(FIXTURE)
+    row = {
+        "metric": "mini10017 fixture 3-picker consensus, end-to-end",
+        "value": round(n / warm_total_s, 3),
+        "unit": "micrographs/sec",
+        "platform": "cpu",
+        "micrographs": n,
+        "warm_total_s": round(warm_total_s, 4),
+        "first_call_s": round(first_call_s, 2),
+    }
+    # driver-wrapper shape, so the artifact is interchangeable with
+    # the BENCH_r0N.json files bench_compare already reads
+    print(json.dumps({"parsed": row}, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

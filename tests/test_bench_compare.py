@@ -1,0 +1,138 @@
+"""scripts/bench_compare.py: the BENCH-artifact regression differ."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(ROOT, "scripts", "bench_compare.py")
+
+
+def _artifact(tmp_path, name, wrapped=True, **row):
+    base = {
+        "metric": "test metric",
+        "value": 50.0,
+        "unit": "micrographs/sec",
+        "warm_total_s": 0.25,
+        "first_call_s": 1.0,
+    }
+    base.update(row)
+    path = tmp_path / name
+    path.write_text(
+        json.dumps({"parsed": base} if wrapped else base)
+    )
+    return str(path)
+
+
+def _run(*args):
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, *args],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    return proc.returncode, proc.stdout, proc.stderr
+
+
+def test_within_threshold_ok(tmp_path):
+    a = _artifact(tmp_path, "a.json")
+    b = _artifact(tmp_path, "b.json", value=48.0)  # -4%
+    rc, out, _ = _run(a, b, "--threshold-pct", "10")
+    assert rc == 0
+    assert "ok (threshold 10%)" in out
+
+
+def test_throughput_regression_fails(tmp_path):
+    a = _artifact(tmp_path, "a.json")
+    b = _artifact(tmp_path, "b.json", value=30.0)  # -40%
+    rc, out, _ = _run(a, b, "--threshold-pct", "10")
+    assert rc == 1
+    assert "REGRESSION" in out
+
+
+def test_latency_regression_direction(tmp_path):
+    # lower-is-better fields: a HIGHER first_call_s is the regression
+    a = _artifact(tmp_path, "a.json")
+    b = _artifact(tmp_path, "b.json", first_call_s=2.0)
+    rc, out, _ = _run(a, b, "--threshold-pct", "10")
+    assert rc == 1
+    assert "first_call_s" in out
+    # and improvements never fail
+    c = _artifact(tmp_path, "c.json", first_call_s=0.2, value=90.0,
+                  warm_total_s=0.1)
+    rc, _, _ = _run(a, c, "--threshold-pct", "10")
+    assert rc == 0
+
+
+def test_advisory_mode_reports_but_passes(tmp_path):
+    a = _artifact(tmp_path, "a.json")
+    b = _artifact(tmp_path, "b.json", value=1.0)
+    rc, out, _ = _run(a, b, "--advisory")
+    assert rc == 0
+    assert "REGRESSION" in out and "[advisory]" in out
+
+
+def test_json_output_and_raw_row_shape(tmp_path):
+    a = _artifact(tmp_path, "a.json", wrapped=False)
+    b = _artifact(tmp_path, "b.json", value=20.0)
+    rc, out, _ = _run(a, b, "--json", "--advisory")
+    assert rc == 0
+    doc = json.loads(out)
+    assert doc["ok"] is False
+    fields = {f["field"]: f for f in doc["fields"]}
+    assert fields["value"]["regressed"] is True
+    assert fields["warm_total_s"]["regressed"] is False
+
+
+def test_unusable_input_exits_2(tmp_path):
+    a = tmp_path / "bad.json"
+    a.write_text("[]")
+    b = _artifact(tmp_path, "b.json")
+    rc, _, err = _run(str(a), str(b))
+    assert rc == 2
+    assert "error" in err
+    # comparable artifacts missing every headline field also exit 2
+    c = tmp_path / "c.json"
+    c.write_text(json.dumps({"parsed": {"metric": "m"}}))
+    rc, _, err = _run(str(c), str(c))
+    assert rc == 2
+
+
+def test_checked_in_fixture_baseline_is_readable():
+    baseline = os.path.join(
+        ROOT, "tests", "golden", "BENCH_fixture_baseline.json"
+    )
+    rc, out, _ = _run(baseline, baseline)
+    assert rc == 0, out
+    assert "+0.0%" in out
+
+
+@pytest.mark.slow
+def test_fixture_bench_emits_comparable_artifact(tmp_path):
+    """scripts/bench_fixture.py output diffs cleanly against the
+    checked-in baseline (the advisory CI step end-to-end)."""
+    fixture_script = os.path.join(ROOT, "scripts", "bench_fixture.py")
+    proc = subprocess.run(
+        [sys.executable, fixture_script],
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    current = tmp_path / "current.json"
+    current.write_text(proc.stdout)
+    baseline = os.path.join(
+        ROOT, "tests", "golden", "BENCH_fixture_baseline.json"
+    )
+    rc, out, err = _run(
+        baseline, str(current), "--advisory", "--json"
+    )
+    assert rc == 0, err
+    doc = json.loads(out)
+    assert {f["field"] for f in doc["fields"]} == {
+        "value", "warm_total_s", "first_call_s",
+    }

@@ -372,3 +372,62 @@ def test_help_surfaces_round5_flags(capsys):
         out = capsys.readouterr().out
         for flag in flags:
             assert flag in out, f"{cmd} lost {flag}"
+
+
+def test_help_surfaces_observability_flags(capsys):
+    """ISSUE 7 flag surface: the live observability plane (status
+    server, device-time attribution) and the profiler trace dir are
+    registered on every CLI the plane covers."""
+    for cmd, flags in [
+        ("consensus", ["--status-port", "--device-time",
+                       "--trace-dir"]),
+        ("pick", ["--trace-dir", "--device-time"]),
+        ("fit", ["--trace-dir", "--device-time"]),
+    ]:
+        with pytest.raises(SystemExit):
+            cli_main([cmd, "--help"])
+        out = capsys.readouterr().out
+        for flag in flags:
+            assert flag in out, f"{cmd} lost {flag}"
+
+
+def test_consensus_cli_device_time_and_status_port(tmp_path, rng):
+    """End-to-end CLI smoke for the observability plane: a run with
+    --device-time, --trace-dir, and an ephemeral --status-port
+    completes, journals, and reports the device-time section."""
+    import json as _json
+
+    from repic_tpu.telemetry import probes
+    from repic_tpu.telemetry import server as tlm_server
+    from repic_tpu.telemetry.report import build_report
+
+    in_dir, names = _write_picker_dirs(tmp_path, rng, n_micro=2)
+    out_dir = tmp_path / "out"
+    trace_dir = tmp_path / "trace"
+    try:
+        cli_main(
+            [
+                "consensus", str(in_dir), str(out_dir), "180",
+                "--no_mesh", "--status-port", "0", "--device-time",
+                "--trace-dir", str(trace_dir),
+            ]
+        )
+    finally:
+        probes.set_device_time(False)  # process-wide: restore
+    # the CLI stopped the server on exit
+    assert tlm_server.active_server() is None
+    for name in names:
+        assert (out_dir / f"{name}.box").exists()
+    report = build_report(str(out_dir))
+    assert "consensus_chunk" in report["device_time"]["stages"]
+    assert report["schema_version"] == 2
+    # the profiler session ran and left a trace directory the event
+    # stream points at
+    assert trace_dir.exists()
+    events_text = (out_dir / "_events.jsonl").read_text()
+    rec = next(
+        _json.loads(line)
+        for line in events_text.splitlines()
+        if '"trace_dir"' in line
+    )
+    assert rec["path"] == str(trace_dir.resolve())

@@ -219,6 +219,37 @@ def test_three_host_crash_then_resume(tmp_path):
     assert "cluster hosts:" in text
     assert "host ladder:" in text
 
+    # -- per-host telemetry artifacts (live observability plane) ----
+    # every host streams to its OWN _events.<host>.jsonl and
+    # _metrics.<host>.json; a shared-name clobber would lose the
+    # crashed host's spans exactly when the post-mortem needs them
+    import glob as _glob
+
+    ev_files = _glob.glob(str(out_dir / "_events.*.jsonl"))
+    ev_hosts = {
+        os.path.basename(p)[len("_events.") : -len(".jsonl")]
+        for p in ev_files
+    }
+    assert {"w0", "w2"} <= ev_hosts, ev_hosts
+    assert not os.path.exists(
+        str(out_dir / "_events.jsonl")
+    ), "cluster run wrote the single-process event log name"
+    for host in ("w0", "w2"):  # clean finishers wrote snapshots
+        assert os.path.exists(
+            str(out_dir / f"_metrics.{host}.json")
+        ), host
+        assert os.path.exists(
+            str(out_dir / f"_metrics.{host}.prom")
+        ), host
+    # report merges them: summed device totals + per-host breakdown
+    assert report["device"]["transfer_bytes"] > 0, report["device"]
+    assert report["schema_version"] >= 2
+    tele = cluster.get("telemetry", {})
+    assert {"w0", "w2"} <= set(tele), tele
+    assert all(
+        row.get("transfer_bytes", 0) > 0 for row in tele.values()
+    ), tele
+
 
 def test_two_host_in_run_takeover(tmp_path):
     """In-run reassignment (no resume generation): one of two hosts

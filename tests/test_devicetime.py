@@ -1,0 +1,315 @@
+"""Device-time attribution: span sync brackets, trace parsing, and
+the report's device-vs-host split (ISSUE 7 tentpole part 3)."""
+
+import gzip
+import json
+import os
+
+import numpy as np
+import pytest
+
+from repic_tpu.telemetry import devicetime, probes
+from repic_tpu.telemetry import events as tlm_events
+
+
+@pytest.fixture
+def device_time_mode():
+    probes.set_device_time(True)
+    try:
+        yield
+    finally:
+        probes.set_device_time(False)
+
+
+def test_sync_device_returns_nonnegative_seconds():
+    assert probes.sync_device() >= 0.0
+
+
+def test_spans_carry_device_fields_when_enabled(
+    tmp_path, device_time_mode
+):
+    log = tlm_events.EventLog(str(tmp_path / "_events.jsonl"))
+    prev = tlm_events.set_current_log(log)
+    try:
+        with tlm_events.span("stage_a"):
+            pass
+    finally:
+        tlm_events.set_current_log(prev)
+        log.close()
+    (rec,) = [
+        r
+        for r in tlm_events.read_events(str(tmp_path))
+        if r.get("ev") == "span"
+    ]
+    assert "host_s" in rec and "device_tail_s" in rec
+    assert rec["dur_s"] >= rec["host_s"]
+    assert rec["device_tail_s"] >= 0.0
+
+
+def test_spans_omit_device_fields_when_disabled(tmp_path):
+    log = tlm_events.EventLog(str(tmp_path / "_events.jsonl"))
+    prev = tlm_events.set_current_log(log)
+    try:
+        with tlm_events.span("stage_a"):
+            pass
+    finally:
+        tlm_events.set_current_log(prev)
+        log.close()
+    (rec,) = tlm_events.read_events(str(tmp_path))
+    assert "device_tail_s" not in rec and "host_s" not in rec
+
+
+def test_span_device_time_aggregates_per_stage_and_capacity():
+    records = [
+        {"ev": "span", "name": "consensus_chunk", "capacity": 128,
+         "dur_s": 1.0, "host_s": 0.7, "device_tail_s": 0.3},
+        {"ev": "span", "name": "consensus_chunk", "capacity": 128,
+         "dur_s": 1.0, "host_s": 0.5, "device_tail_s": 0.5},
+        {"ev": "span", "name": "consensus_chunk", "capacity": 256,
+         "dur_s": 2.0, "host_s": 1.0, "device_tail_s": 1.0},
+        {"ev": "span", "name": "write",
+         "dur_s": 0.2, "host_s": 0.2, "device_tail_s": 0.0},
+        {"ev": "event", "name": "not_a_span"},
+        {"ev": "span", "name": "untimed_span", "dur_s": 0.1},
+    ]
+    out = devicetime.span_device_time(records)
+    chunk = out["stages"]["consensus_chunk"]
+    assert chunk["count"] == 3
+    assert chunk["host_s"] == pytest.approx(2.2)
+    assert chunk["device_tail_s"] == pytest.approx(1.8)
+    assert 0 < chunk["device_frac"] < 1
+    assert out["by_capacity"][128]["count"] == 2
+    assert out["by_capacity"][256]["device_tail_s"] == pytest.approx(
+        1.0
+    )
+    # untimed spans don't pollute the split
+    assert "untimed_span" not in out["stages"]
+    assert out["dispatch_gap_s"] == pytest.approx(2.2 - 1.8)
+
+
+def test_span_device_time_empty_without_mode():
+    records = [{"ev": "span", "name": "x", "dur_s": 1.0}]
+    assert devicetime.span_device_time(records) == {}
+
+
+def _write_chrome_trace(trace_dir, gz=True):
+    run_dir = os.path.join(
+        trace_dir, "plugins", "profile", "2026_08_03_00_00_00"
+    )
+    os.makedirs(run_dir, exist_ok=True)
+    trace = {
+        "traceEvents": [
+            {"ph": "M", "pid": 1, "name": "process_name",
+             "args": {"name": "/host:CPU python"}},
+            {"ph": "M", "pid": 7, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            # host lane: 0..1000us
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 1000,
+             "name": "dispatch"},
+            # device lane: two kernels, 400us busy
+            {"ph": "X", "pid": 7, "tid": 1, "ts": 100, "dur": 300,
+             "name": "fusion.1"},
+            {"ph": "X", "pid": 7, "tid": 1, "ts": 500, "dur": 100,
+             "name": "fusion.2"},
+            # HOST lane whose name merely contains "tpu" — a bare
+            # substring match would misclassify it as device busy
+            {"ph": "M", "pid": 9, "name": "process_name",
+             "args": {"name": "python repic_tpu tpu_driver pool"}},
+            {"ph": "X", "pid": 9, "tid": 1, "ts": 0, "dur": 900,
+             "name": "callback"},
+        ]
+    }
+    name = "local.trace.json.gz" if gz else "local.trace.json"
+    path = os.path.join(run_dir, name)
+    if gz:
+        with gzip.open(path, "wt") as f:
+            json.dump(trace, f)
+    else:
+        with open(path, "wt") as f:
+            json.dump(trace, f)
+    return path
+
+
+@pytest.mark.parametrize("gz", [True, False])
+def test_parse_trace_dir_chrome_trace(tmp_path, gz):
+    _write_chrome_trace(str(tmp_path), gz=gz)
+    out = devicetime.parse_trace_dir(str(tmp_path))
+    assert out["device_ops"] == 2
+    assert out["device_busy_s"] == pytest.approx(400e-6)
+    assert out["wall_s"] == pytest.approx(1000e-6)
+    assert out["dispatch_gap_s"] == pytest.approx(600e-6)
+    assert out["files"]
+
+
+def test_parse_trace_dir_degrades_to_empty(tmp_path):
+    assert devicetime.parse_trace_dir(str(tmp_path)) == {}
+    bad = tmp_path / "plugins" / "profile" / "r"
+    bad.mkdir(parents=True)
+    (bad / "x.trace.json").write_text("{not json")
+    assert devicetime.parse_trace_dir(str(tmp_path)) == {}
+
+
+def _tiny_pick_dir(tmp_path, m=3):
+    rng = np.random.default_rng(11)
+    d = tmp_path / "picks"
+    for p in range(3):
+        (d / f"picker{p}").mkdir(parents=True)
+    for i in range(m):
+        base = rng.uniform(50, 950, size=(15, 2))
+        for p in range(3):
+            xy = base + rng.normal(0, 5, size=base.shape)
+            with open(d / f"picker{p}" / f"mic{i}.box", "wt") as f:
+                for (x, y) in xy:
+                    f.write(f"{x:.2f}\t{y:.2f}\t64\t64\t0.5\n")
+    return str(d)
+
+
+def test_report_gains_device_time_section(
+    tmp_path, device_time_mode
+):
+    """End-to-end: a device-timed run's report carries the per-stage
+    host-vs-device split and the per-capacity-bucket rows (the ISSUE
+    acceptance field)."""
+    from repic_tpu.pipeline.consensus import run_consensus_dir
+    from repic_tpu.telemetry.report import build_report, format_report
+
+    data = _tiny_pick_dir(tmp_path)
+    out = str(tmp_path / "out")
+    run_consensus_dir(data, out, 64, use_mesh=False)
+    report = build_report(out)
+    dt = report["device_time"]
+    assert "consensus_chunk" in dt["stages"]
+    st = dt["stages"]["consensus_chunk"]
+    assert st["host_s"] > 0
+    assert st["device_tail_s"] >= 0
+    assert dt["by_capacity"], dt
+    assert "dispatch_gap_s" in dt
+    text = format_report(report)
+    assert "device time (host vs device tail, s):" in text
+    assert "dispatch gap (est):" in text
+
+
+def test_report_omits_device_time_without_mode(tmp_path):
+    from repic_tpu.pipeline.consensus import run_consensus_dir
+    from repic_tpu.telemetry.report import build_report
+
+    data = _tiny_pick_dir(tmp_path, m=2)
+    out = str(tmp_path / "out")
+    run_consensus_dir(data, out, 64, use_mesh=False)
+    assert "device_time" not in build_report(out)
+
+
+def test_report_joins_trace_dir_breadcrumb(tmp_path):
+    """A `trace_dir` event in the stream pulls the parsed profiler
+    summary into the device-time section (jax-free join)."""
+    from repic_tpu.telemetry.report import build_report
+
+    trace_dir = tmp_path / "trace"
+    _write_chrome_trace(str(trace_dir))
+    out = tmp_path / "run"
+    out.mkdir()
+    with open(out / "_events.jsonl", "wt") as f:
+        f.write(
+            json.dumps(
+                {"ev": "span", "name": "consensus_chunk", "run": "r1",
+                 "t": 1.0, "dur_s": 1.0, "host_s": 0.8,
+                 "device_tail_s": 0.2, "capacity": 64}
+            )
+            + "\n"
+        )
+        f.write(
+            json.dumps(
+                {"ev": "event", "name": "trace_dir", "run": "r1",
+                 "t": 1.5, "path": str(trace_dir)}
+            )
+            + "\n"
+        )
+    with open(out / "_journal.jsonl", "wt") as f:
+        f.write(
+            json.dumps(
+                {"name": "mic0", "status": "ok", "ts": 1.0}
+            )
+            + "\n"
+        )
+    report = build_report(str(out))
+    trace = report["device_time"]["trace"]
+    assert trace["device_ops"] == 2
+    assert trace["dispatch_gap_s"] == pytest.approx(600e-6)
+
+
+def test_dispatch_gap_floors_per_span_not_aggregate():
+    """Regression: a device-saturated chunk must not cancel a
+    dispatch-bound chunk's stall — the gap accumulates
+    max(host - tail, 0) per span."""
+    records = [
+        # dispatch-bound: 10s of host stall
+        {"ev": "span", "name": "consensus_chunk", "capacity": 64,
+         "dur_s": 10.0, "host_s": 10.0, "device_tail_s": 0.0},
+        # device-saturated: tail exceeds host time
+        {"ev": "span", "name": "consensus_chunk", "capacity": 64,
+         "dur_s": 7.0, "host_s": 1.0, "device_tail_s": 6.0},
+    ]
+    out = devicetime.span_device_time(records)
+    # aggregate flooring would give max(11 - 6, 0) = 5
+    assert out["dispatch_gap_s"] == pytest.approx(10.0)
+
+
+def test_dispatch_gap_prefers_dispatch_spans():
+    """The gap comes from consensus_dispatch spans (closed right
+    after the async dispatch) when present — the chunk span contains
+    the blocking fetch, so its tail is ~0 by construction and would
+    read every run as dispatch-bound."""
+    records = [
+        # chunk span: fetch drained the device, tail ~0 (useless)
+        {"ev": "span", "name": "consensus_chunk", "capacity": 128,
+         "dur_s": 5.0, "host_s": 5.0, "device_tail_s": 0.0},
+        # dispatch span: 0.5s host dispatch, 4.0s device execution
+        {"ev": "span", "name": "consensus_dispatch", "capacity": 128,
+         "dur_s": 4.5, "host_s": 0.5, "device_tail_s": 4.0},
+    ]
+    out = devicetime.span_device_time(records)
+    # chunk-based flooring would report 5.0 (all dispatch-bound);
+    # the dispatch span shows the device was saturated
+    assert out["dispatch_gap_s"] == pytest.approx(0.0)
+    assert out["by_capacity"][128]["device_tail_s"] == pytest.approx(
+        4.0
+    )
+
+
+def test_report_trace_join_prefers_latest_breadcrumb(tmp_path):
+    """Regression: the run log appends across re-runs into one
+    out_dir — the trace section must describe the LAST recorded
+    trace, not a superseded earlier one."""
+    from repic_tpu.telemetry.report import build_report
+
+    stale = tmp_path / "t1"
+    fresh = tmp_path / "t2"
+    _write_chrome_trace(str(stale))
+    # fresh trace has ONE device op so the two are distinguishable
+    run_dir = os.path.join(
+        str(fresh), "plugins", "profile", "r2"
+    )
+    os.makedirs(run_dir)
+    with open(os.path.join(run_dir, "x.trace.json"), "wt") as f:
+        json.dump({"traceEvents": [
+            {"ph": "M", "pid": 7, "name": "process_name",
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "pid": 7, "tid": 1, "ts": 0, "dur": 100,
+             "name": "fusion.only"},
+        ]}, f)
+    out = tmp_path / "run"
+    out.mkdir()
+    with open(out / "_events.jsonl", "wt") as f:
+        for t, path in ((1.0, stale), (2.0, fresh)):
+            f.write(json.dumps(
+                {"ev": "event", "name": "trace_dir", "run": "r",
+                 "t": t, "path": str(path)}) + "\n")
+        f.write(json.dumps(
+            {"ev": "span", "name": "consensus_chunk", "run": "r",
+             "t": 2.5, "dur_s": 1.0, "host_s": 0.9,
+             "device_tail_s": 0.1}) + "\n")
+    with open(out / "_journal.jsonl", "wt") as f:
+        f.write(json.dumps(
+            {"name": "mic0", "status": "ok", "ts": 1.0}) + "\n")
+    trace = build_report(str(out))["device_time"]["trace"]
+    assert trace["device_ops"] == 1, trace

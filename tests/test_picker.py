@@ -257,3 +257,52 @@ def test_pick_cli(params, tmp_path, rng):
         f for f in os.listdir(out_dir) if f.endswith(".box")
     )
     assert boxes == ["mic0.box", "mic1.box"]
+
+
+def test_pick_cli_trace_dir_and_device_time(params, tmp_path, rng):
+    """ISSUE 7 satellite: the observability flags are wired into
+    `pick`, not just `consensus` — a traced, device-timed pick run
+    leaves the trace dir, device-split span fields, and the
+    trace_dir breadcrumb next to its outputs."""
+    import json
+
+    from repic_tpu.main import main as cli_main
+    from repic_tpu.telemetry import events as tlm_events
+    from repic_tpu.telemetry import probes
+    from repic_tpu.utils import mrc
+
+    mrc_dir = tmp_path / "mrcs"
+    out_dir = tmp_path / "out"
+    trace_dir = tmp_path / "trace"
+    mrc_dir.mkdir()
+    mrc.write_mrc(
+        str(mrc_dir / "mic0.mrc"),
+        rng.normal(size=(400, 400)).astype(np.float32),
+    )
+    ckpt = str(tmp_path / "model.rptpu")
+    save_checkpoint(
+        ckpt, params, {"particle_size": 120, "patch_norm": "reference"}
+    )
+    try:
+        cli_main(
+            [
+                "pick", ckpt, str(mrc_dir), str(out_dir),
+                "--trace-dir", str(trace_dir), "--device-time",
+            ]
+        )
+    finally:
+        probes.set_device_time(False)  # process-wide: restore
+    assert trace_dir.exists()
+    records = tlm_events.read_events(str(out_dir))
+    span = next(
+        r for r in records
+        if r.get("ev") == "span" and r["name"] == "pick_micrograph"
+    )
+    assert "device_tail_s" in span and "host_s" in span
+    breadcrumb = next(
+        r for r in records
+        if r.get("ev") == "event" and r.get("name") == "trace_dir"
+    )
+    assert json.loads(json.dumps(breadcrumb))["path"] == str(
+        trace_dir.resolve()
+    )

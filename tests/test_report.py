@@ -217,3 +217,72 @@ def test_events_stream_has_run_id_and_chunk_spans(journaled_run):
     # size 2 -> chunks of 2, 2, 1)
     chunk_spans = [s for s in spans if s["name"] == "consensus_chunk"]
     assert sorted(s["micrographs"] for s in chunk_spans) == [1, 2, 2]
+
+
+def test_report_json_carries_schema_version(journaled_run, capsys):
+    """Satellite: the --json output pins its field contract
+    (docs/observability.md "Report JSON contract")."""
+    out_dir, _ = journaled_run
+    report = build_report(out_dir)
+    assert report["schema_version"] == 2
+    cli_main(["report", out_dir, "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["schema_version"] == 2
+
+
+def test_report_merges_per_host_metrics_and_events(tmp_path):
+    """Cluster artifacts: per-host _metrics.<host>.json sum into the
+    device section and break out per host in the cluster section;
+    per-host event logs merge into one stage table."""
+    from repic_tpu.telemetry import sinks as tlm_sinks
+
+    out = tmp_path / "run"
+    out.mkdir()
+    # two hosts' journals (cluster mode markers)
+    with open(out / "_journal.h1.jsonl", "wt") as f:
+        f.write(json.dumps(
+            {"name": "mic0", "status": "ok", "ts": 1.0, "host": "h1"}
+        ) + "\n")
+    with open(out / "_journal.h2.jsonl", "wt") as f:
+        f.write(json.dumps(
+            {"name": "mic1", "status": "ok", "ts": 2.0, "host": "h2"}
+        ) + "\n")
+    # two hosts' metric snapshots with probe gauges
+    def _snap(host, bytes_, recompiles):
+        data = {
+            "repic_transfer_bytes_total": {
+                "kind": "gauge", "help": "",
+                "samples": [{"labels": {}, "value": bytes_}],
+            },
+            "repic_recompiles_total": {
+                "kind": "gauge", "help": "",
+                "samples": [{"labels": {}, "value": recompiles}],
+            },
+        }
+        tlm_sinks.write_metrics_json(
+            str(out / tlm_sinks.host_metrics_json_name(host)),
+            data=data,
+        )
+    _snap("h1", 1000, 2)
+    _snap("h2", 500, 1)
+    # two hosts' event logs
+    with open(out / "_events.h1.jsonl", "wt") as f:
+        f.write(json.dumps(
+            {"ev": "span", "name": "consensus_chunk", "run": "r",
+             "t": 1.0, "dur_s": 0.5}
+        ) + "\n")
+    with open(out / "_events.h2.jsonl", "wt") as f:
+        f.write(json.dumps(
+            {"ev": "span", "name": "consensus_chunk", "run": "r",
+             "t": 2.0, "dur_s": 0.7}
+        ) + "\n")
+
+    report = build_report(str(out))
+    assert report["device"]["transfer_bytes"] == 1500
+    assert report["device"]["recompiles"] == 3
+    assert report["stages"]["consensus_chunk"]["count"] == 2
+    tele = report["cluster"]["telemetry"]
+    assert tele == {
+        "h1": {"recompiles": 2, "transfer_bytes": 1000},
+        "h2": {"recompiles": 1, "transfer_bytes": 500},
+    }

@@ -1,0 +1,222 @@
+"""In-process status server: /metrics, /status, /healthz.
+
+The acceptance contract (ISSUE 7): live, well-formed data mid-run
+when ``--status-port`` is set, and ZERO overhead — nothing bound,
+spawned, or accumulated — when it is unset (the PR 3 disabled-mode
+discipline).
+"""
+
+import json
+import os
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from repic_tpu.telemetry import server as tlm_server
+from repic_tpu.telemetry.metrics import MetricsRegistry
+
+# every non-comment exposition line: name{labels} value
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+$"
+)
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5
+    ) as resp:
+        return resp.status, resp.headers, resp.read().decode()
+
+
+@pytest.fixture
+def server():
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("repic_test_total", "test counter").inc(3, kind="a")
+    reg.histogram("repic_test_seconds", "test histogram").observe(0.02)
+    srv = tlm_server.StatusServer(port=0, registry=reg).start()
+    try:
+        yield srv
+    finally:
+        srv.stop()
+
+
+def test_healthz(server):
+    status, _, body = _get(server.port, "/healthz")
+    assert status == 200
+    assert body == "ok\n"
+
+
+def test_metrics_is_well_formed_exposition(server):
+    status, headers, body = _get(server.port, "/metrics")
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    assert "# TYPE repic_test_total counter" in body
+    assert 'repic_test_total{kind="a"} 3' in body
+    # histogram expansion: cumulative buckets + sum/count + +Inf
+    assert 'repic_test_seconds_bucket{le="+Inf"} 1' in body
+    assert "repic_test_seconds_count 1" in body
+    for line in body.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _PROM_LINE.match(line), f"malformed line: {line!r}"
+
+
+def test_metrics_is_live_not_a_snapshot(server):
+    server.registry.counter("repic_test_total", "").inc(2, kind="a")
+    _, _, body = _get(server.port, "/metrics")
+    assert 'repic_test_total{kind="a"} 5' in body
+
+
+def test_status_document_and_404(server):
+    tlm_server.set_status(run_id="abc123", micrographs_total=7)
+    status, headers, body = _get(server.port, "/status")
+    assert status == 200
+    assert headers["Content-Type"] == "application/json"
+    doc = json.loads(body)
+    assert doc["run_id"] == "abc123"
+    assert doc["micrographs_total"] == 7
+    assert doc["ts"] > 0
+    try:
+        _get(server.port, "/nope")
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_status_includes_cluster_liveness(server, tmp_path):
+    from repic_tpu.runtime.cluster import heartbeat_path
+
+    coord = str(tmp_path)
+    with open(heartbeat_path(coord, "h1"), "wt") as f:
+        json.dump(
+            {"host": "h1", "rank": 0, "seq": 1, "ts": time.time()}, f
+        )
+    tlm_server.set_status(
+        cluster={"coordination_dir": coord, "host_timeout_s": 30.0}
+    )
+    _, _, body = _get(server.port, "/status")
+    hosts = json.loads(body)["cluster"]["hosts"]
+    assert hosts["h1"]["rung"] == "live"
+
+
+def test_set_status_is_noop_without_server():
+    assert tlm_server.active_server() is None
+    tlm_server.set_status(run_id="should-vanish")
+    assert tlm_server.get_status() == {}
+
+
+def test_stop_clears_status_and_unbinds():
+    srv = tlm_server.StatusServer(port=0).start()
+    port = srv.port
+    tlm_server.set_status(run_id="x")
+    srv.stop()
+    assert tlm_server.active_server() is None
+    assert tlm_server.get_status() == {}
+    with pytest.raises(OSError):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=1
+        )
+
+
+def test_maybe_status_server_none_is_inert():
+    with tlm_server.maybe_status_server(None) as srv:
+        assert srv is None
+        assert tlm_server.active_server() is None
+
+
+def test_mid_run_scrape(tmp_path):
+    """The CI acceptance scenario in-process: scrape /status and
+    /metrics while a real consensus run executes."""
+    from repic_tpu.pipeline.consensus import run_consensus_dir
+
+    rng = np.random.default_rng(3)
+    data = tmp_path / "picks"
+    for p in range(3):
+        (data / f"picker{p}").mkdir(parents=True)
+    for i in range(4):
+        base = rng.uniform(50, 950, size=(20, 2))
+        for p in range(3):
+            xy = base + rng.normal(0, 5, size=base.shape)
+            with open(
+                data / f"picker{p}" / f"mic{i}.box", "wt"
+            ) as f:
+                for (x, y) in xy:
+                    f.write(f"{x:.2f}\t{y:.2f}\t64\t64\t0.5\n")
+
+    with tlm_server.maybe_status_server(0) as srv:
+        done = threading.Event()
+        errors = []
+
+        def _run():
+            try:
+                run_consensus_dir(
+                    str(data), str(tmp_path / "out"), 64,
+                    use_mesh=False,
+                )
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+            finally:
+                done.set()
+
+        t = threading.Thread(target=_run)
+        t.start()
+        # scrape while the run is (most likely) still live; the
+        # assertions hold either way — the server outlives the run
+        seen_total = None
+        while not done.is_set():
+            _, _, body = _get(srv.port, "/status")
+            doc = json.loads(body)
+            if doc.get("micrographs_total"):
+                seen_total = doc["micrographs_total"]
+                break
+            time.sleep(0.01)
+        done.wait(timeout=120)
+        t.join(timeout=120)
+        assert not errors, errors
+        # final scrape: complete progress + live registry
+        _, _, body = _get(srv.port, "/status")
+        doc = json.loads(body)
+        assert doc["micrographs_total"] == 4
+        assert doc.get("run_id")
+        if seen_total is not None:
+            assert seen_total == 4
+        _, _, metrics_body = _get(srv.port, "/metrics")
+        assert "repic_consensus_micrographs_total" in metrics_body
+
+
+def test_resumed_run_status_counts_prior_work(tmp_path):
+    """Regression: /status progress covers the WHOLE run — a resumed
+    generation counts the already-done micrographs, not just its own
+    share (a 90%-done resume must not read as 10%)."""
+    from repic_tpu.pipeline.consensus import run_consensus_dir
+
+    rng = np.random.default_rng(7)
+    data = tmp_path / "picks"
+    for p in range(3):
+        (data / f"picker{p}").mkdir(parents=True)
+    for i in range(4):
+        base = rng.uniform(50, 950, size=(15, 2))
+        for p in range(3):
+            xy = base + rng.normal(0, 5, size=base.shape)
+            with open(
+                data / f"picker{p}" / f"mic{i}.box", "wt"
+            ) as f:
+                for (x, y) in xy:
+                    f.write(f"{x:.2f}\t{y:.2f}\t64\t64\t0.5\n")
+    out = str(tmp_path / "out")
+    run_consensus_dir(str(data), out, 64, use_mesh=False)
+    # drop one output + journal entry so the resume has real work
+    os.remove(os.path.join(out, "mic3.box"))
+    with tlm_server.maybe_status_server(0) as srv:
+        run_consensus_dir(
+            str(data), out, 64, use_mesh=False, resume=True
+        )
+        _, _, body = _get(srv.port, "/status")
+        doc = json.loads(body)
+    assert doc["micrographs_total"] == 4
+    assert doc["micrographs_done"] == 4, doc

@@ -311,3 +311,144 @@ def test_event_log_skips_torn_lines(tmp_path):
     )
     records = tlm_events.read_events(str(path))
     assert [r["name"] for r in records] == ["a"]
+
+
+# -- streaming sinks + per-host artifacts (ISSUE 7 tentpole) ---------
+
+
+def test_read_events_merges_per_host_files(tmp_path):
+    """Cluster runs leave one _events.<host>.jsonl per host; the
+    directory read merges them in wall-clock order, tolerating a torn
+    trailing line on any file (the crashed host's log is exactly the
+    one the post-mortem reads) — the journal `_read_entries` parity
+    contract."""
+    (tmp_path / "_events.h1.jsonl").write_text(
+        json.dumps({"ev": "event", "name": "a", "t": 1.0}) + "\n"
+        + json.dumps({"ev": "event", "name": "c", "t": 3.0}) + "\n"
+    )
+    (tmp_path / "_events.h2.jsonl").write_text(
+        json.dumps({"ev": "event", "name": "b", "t": 2.0}) + "\n"
+        + '{"ev": "eve'  # torn mid-append by a host crash
+    )
+    records = tlm_events.read_events(str(tmp_path))
+    assert [r["name"] for r in records] == ["a", "b", "c"]
+
+
+def test_read_events_tolerates_missing_file():
+    # OSError parity with journal._read_entries (deleted under us)
+    assert tlm_events.read_events("/nonexistent/evlog.jsonl") == []
+
+
+def test_host_events_name_sanitizes():
+    assert tlm_events.host_events_name("h/1") == "_events.h_1.jsonl"
+
+
+def test_start_run_per_host_artifact_names(tmp_path):
+    from repic_tpu import telemetry
+
+    rt = telemetry.start_run(
+        str(tmp_path), host="h1", flush_interval_s=0
+    )
+    try:
+        with tlm_events.span("stage_a"):
+            pass
+    finally:
+        telemetry.finish_run(rt)
+    assert (tmp_path / "_events.h1.jsonl").exists()
+    assert (tmp_path / "_metrics.h1.json").exists()
+    assert (tmp_path / "_metrics.h1.prom").exists()
+    assert not (tmp_path / "_events.jsonl").exists()
+    assert not (tmp_path / "_metrics.json").exists()
+    by_host = sinks.read_all_metrics_json(str(tmp_path))
+    assert list(by_host) == ["h1"]
+    assert "repic_span_seconds" in by_host["h1"]
+
+
+def test_flush_run_streams_sinks_mid_run(tmp_path):
+    """flush_run rewrites the metric snapshots while the run is still
+    open — the chunk-boundary streaming contract — and later flushes
+    pick up new samples."""
+    from repic_tpu import telemetry
+    from repic_tpu.telemetry import metrics as tlm_metrics
+
+    c = tlm_metrics.counter(
+        "repic_flush_test_total", "streaming flush test"
+    )
+    rt = telemetry.start_run(str(tmp_path), flush_interval_s=0)
+    try:
+        c.inc(2)
+        telemetry.flush_run(rt)
+        assert (tmp_path / "_metrics.json").exists()
+        mid = sinks.read_metrics_json(str(tmp_path))
+        assert (
+            mid["repic_flush_test_total"]["samples"][0]["value"] == 2
+        )
+        c.inc(3)
+        telemetry.flush_run(rt)
+        mid = sinks.read_metrics_json(str(tmp_path))
+        assert (
+            mid["repic_flush_test_total"]["samples"][0]["value"] == 5
+        )
+    finally:
+        telemetry.finish_run(rt)
+    # finish still finalizes (idempotent over the stream)
+    final = sinks.read_metrics_json(str(tmp_path))
+    assert final["repic_flush_test_total"]["samples"][0]["value"] == 5
+    # and post-finish flushes are no-ops
+    c.inc(100)
+    telemetry.flush_run(rt)
+    assert (
+        sinks.read_metrics_json(str(tmp_path))[
+            "repic_flush_test_total"
+        ]["samples"][0]["value"]
+        == 5
+    )
+
+
+def test_periodic_flusher_writes_without_explicit_flush(tmp_path):
+    from repic_tpu import telemetry
+
+    rt = telemetry.start_run(str(tmp_path), flush_interval_s=0.05)
+    try:
+        deadline = time.time() + 10.0
+        while not (tmp_path / "_metrics.json").exists():
+            assert time.time() < deadline, "flusher never fired"
+            time.sleep(0.02)
+    finally:
+        telemetry.finish_run(rt)
+    assert rt._flusher is not None and not rt._flusher.is_alive()
+
+
+def test_flush_disabled_telemetry_is_noop(tmp_path, monkeypatch):
+    from repic_tpu import telemetry
+    from repic_tpu.telemetry import metrics as tlm_metrics
+
+    monkeypatch.setattr(
+        tlm_metrics.REGISTRY, "_enabled", False
+    )
+    rt = telemetry.start_run(str(tmp_path))
+    telemetry.flush_run(rt)
+    telemetry.finish_run(rt)
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_prom_snapshot_carries_span_histogram(tmp_path):
+    """Satellite: span durations land in the labeled
+    repic_span_seconds histogram, so _metrics.prom carries latency
+    distributions without parsing the event log."""
+    from repic_tpu import telemetry
+
+    rt = telemetry.start_run(str(tmp_path), flush_interval_s=0)
+    try:
+        with tlm_events.span("prom_hist_stage"):
+            time.sleep(0.002)
+    finally:
+        telemetry.finish_run(rt)
+    prom = (tmp_path / "_metrics.prom").read_text()
+    assert (
+        'repic_span_seconds_bucket{le="+Inf",name="prom_hist_stage"}'
+        in prom
+    )
+    assert (
+        'repic_span_seconds_count{name="prom_hist_stage"} 1' in prom
+    )
