@@ -1,0 +1,120 @@
+"""``serve`` subcommand — the long-lived consensus daemon.
+
+New capability beyond the reference CLI (ROADMAP item 1): instead of
+one cold process per run (re-paying the first-call compile every
+time), a daemon ingests BOX-set consensus jobs over HTTP and runs
+them through the warm consensus core, with admission control,
+per-request deadlines, a circuit breaker, graceful drain, and a
+crash-safe request journal.  API contract and operator runbook:
+docs/serving.md.
+"""
+
+name = "serve"
+
+
+def add_arguments(parser):
+    parser.add_argument(
+        "work_dir",
+        help="daemon state directory: the request journal "
+        "(_serve_journal.jsonl), the discovery file (_serve.json "
+        "with the bound port), and one jobs/<id>/ output directory "
+        "per request.  Reusing it across restarts is what makes "
+        "accepted jobs crash-safe",
+    )
+    parser.add_argument(
+        "--port",
+        type=int,
+        default=0,
+        help="listen port on 127.0.0.1 (default 0: ephemeral — read "
+        "the bound port from <work_dir>/_serve.json or stderr). "
+        "Exposure beyond the host is a deployment concern (SSH "
+        "tunnel, sidecar proxy), deliberately not a flag",
+    )
+    parser.add_argument(
+        "--queue-limit",
+        type=int,
+        default=8,
+        metavar="N",
+        help="bounded backlog (queued + running) before admission "
+        "returns 429 with Retry-After (default 8)",
+    )
+    parser.add_argument(
+        "--default-deadline",
+        type=float,
+        default=None,
+        metavar="S",
+        help="deadline applied to requests that do not set "
+        "deadline_s themselves (default: none — jobs run to "
+        "completion)",
+    )
+    parser.add_argument(
+        "--drain-grace",
+        type=float,
+        default=30.0,
+        metavar="S",
+        help="on SIGTERM, seconds the in-flight job may keep "
+        "running before a cooperative cancel at its next chunk "
+        "boundary (default 30; the job is journaled and resumes "
+        "on the next start either way)",
+    )
+    parser.add_argument(
+        "--breaker-threshold",
+        type=int,
+        default=3,
+        metavar="N",
+        help="consecutive job FAILURES that open the circuit "
+        "breaker (default 3; deadline/cancel outcomes never count)",
+    )
+    parser.add_argument(
+        "--breaker-cooldown",
+        type=float,
+        default=30.0,
+        metavar="S",
+        help="seconds the open breaker rejects submissions (503) "
+        "before a half-open probe (default 30)",
+    )
+    parser.add_argument(
+        "--no-warmup",
+        action="store_true",
+        help="skip the startup warmup compile; readiness goes green "
+        "immediately and the first request pays the first compile",
+    )
+
+
+def main(args):
+    import sys
+
+    from repic_tpu.serve.daemon import ConsensusDaemon
+
+    daemon = ConsensusDaemon(
+        args.work_dir,
+        port=args.port,
+        queue_limit=args.queue_limit,
+        default_deadline_s=args.default_deadline,
+        drain_grace_s=args.drain_grace,
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_s=args.breaker_cooldown,
+        warmup=not args.no_warmup,
+    )
+    try:
+        daemon.start()
+    except OSError as e:
+        raise SystemExit(
+            f"repic-tpu serve: cannot bind port {args.port}: {e}"
+        ) from e
+    print(
+        f"serve: http://127.0.0.1:{daemon.server.port} "
+        "(POST /v1/jobs; /metrics /status /healthz/ready) "
+        f"[work_dir {daemon.work_dir}]",
+        file=sys.stderr,
+    )
+    daemon.install_signal_handlers()
+    daemon.run_until_signalled()
+
+
+if __name__ == "__main__":
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    add_arguments(parser)
+    main(parser.parse_args())

@@ -34,6 +34,7 @@ COMMANDS = {
     "lint": "repic_tpu.analysis.cli",
     "check": "repic_tpu.analysis.check_cli",
     "report": "repic_tpu.commands.report",
+    "serve": "repic_tpu.commands.serve",
 }
 
 

@@ -83,6 +83,32 @@ _MICROGRAPHS = telemetry.counter(
     "repic_consensus_micrographs_total",
     "micrographs processed by directory-scale consensus runs",
 )
+# RT105-style static-signature fingerprints as a LIVE metric: every
+# executed batch whose (config, input-shape) signature was already
+# seen this process reuses a compiled program (a warm serve request);
+# a new signature pays trace+compile.  The ratio on /metrics is the
+# serve daemon's headline cache-effectiveness signal.
+_PROGRAM_HITS = telemetry.counter(
+    "repic_program_cache_hits_total",
+    "consensus batch executions whose program signature was already "
+    "compiled this process (warm path)",
+)
+_PROGRAM_MISSES = telemetry.counter(
+    "repic_program_cache_misses_total",
+    "consensus batch executions that compiled a new program "
+    "signature (cold path: trace + XLA compile)",
+)
+_PROGRAM_SIGNATURES: set = set()
+
+
+class ConsensusCancelled(RuntimeError):
+    """Cooperative cancellation observed at a chunk boundary.
+
+    Raised by :func:`iter_consensus_chunks` when its ``cancel`` hook
+    reports a reason BETWEEN chunks — never mid-program, so every
+    already-yielded chunk's outputs are complete and journaled.  The
+    serve daemon maps this onto per-request deadlines and client
+    cancellation (:mod:`repic_tpu.serve`)."""
 
 
 class ConsensusResult(NamedTuple):
@@ -720,6 +746,18 @@ def run_consensus_batch(
             use_pallas=use_pallas,
             partial_capacity=pcap,
         )
+        # Cache-effectiveness probe: the executable actually reused is
+        # keyed by this exact (static config, input shape) signature —
+        # the same signature RT105 fingerprints at check time.
+        sig = (
+            threshold, d, cap, mesh is not None, grid, cell_cap,
+            solver, use_pallas, pcap, batch.xy.shape,
+        )
+        if sig in _PROGRAM_SIGNATURES:
+            _PROGRAM_HITS.inc()
+        else:
+            _PROGRAM_SIGNATURES.add(sig)
+            _PROGRAM_MISSES.inc()
         xy, conf, mask = batch.xy, batch.conf, batch.mask
         if mesh is not None:
             xy, conf, mask = shard_over_micrographs(mesh, xy, conf, mask)
@@ -823,6 +861,48 @@ def _write_box_file(
     return n if num_particles is None else min(n, num_particles)
 
 
+def emit_box_chunk(
+    batch: PaddedBatch,
+    packed: np.ndarray,
+    box_size,
+    *,
+    num_particles: int | None = None,
+    sink,
+) -> dict[str, int]:
+    """Emit one chunk's consensus BOX files through a sink — pure.
+
+    The emission half of the plan -> execute chunk -> emit split
+    (:mod:`repic_tpu.pipeline.engine`): no filesystem assumptions.
+    ``sink(filename, content)`` receives each micrograph's rendered
+    BOX content; the CLI path writes files atomically, the serve
+    daemon writes into per-request directories.  ``packed`` is the
+    fetched :func:`_pack_box_outputs` array of the chunk (the same
+    single transfer the escalation check already paid).  Returns the
+    per-micrograph written-row counts.
+    """
+    picked, rep_xy, confidence, rep_slot, _ = (
+        _unpack_box_outputs(packed)
+    )
+    sizes = np.asarray(box_size)
+    counts: dict[str, int] = {}
+    for i, name in enumerate(batch.names):
+        if not name:
+            continue
+        sel = np.where(picked[i])[0]
+        row_sizes = (
+            sizes[rep_slot[i, sel]] if sizes.ndim else box_size
+        )
+        content, n = box_io.render_box(
+            rep_xy[i, sel],
+            confidence[i, sel],
+            row_sizes,
+            num_particles=num_particles,
+        )
+        sink(name + ".box", content)
+        counts[name] = n
+    return counts
+
+
 def write_consensus_boxes(
     batch: PaddedBatch,
     res: ConsensusResult,
@@ -854,24 +934,19 @@ def write_consensus_boxes(
         if prefetched_packed is None
         else prefetched_packed
     )
-    picked, rep_xy, confidence, rep_slot, num_cliques = (
-        _unpack_box_outputs(packed)
+
+    def _sink(fname, content):
+        with atomic_write(os.path.join(out_dir, fname)) as o:
+            o.write(content)
+
+    counts = emit_box_chunk(
+        batch, packed, box_size,
+        num_particles=num_particles, sink=_sink,
     )
-    counts = {}
-    for i, name in enumerate(batch.names):
-        if not name:
-            continue
-        sel = np.where(picked[i])[0]
-        counts[name] = _write_box_file(
-            os.path.join(out_dir, name + ".box"),
-            rep_xy[i, sel],
-            confidence[i, sel],
-            rep_slot[i, sel],
-            box_size,
-            num_particles,
-        )
     if with_num_cliques:
-        return counts, num_cliques
+        return counts, _packed_probes(packed)[:, _HEAD_NC].astype(
+            np.int64
+        )
     return counts
 
 
@@ -1601,6 +1676,10 @@ def run_consensus_dir(
                 # stripe) — stream the sinks and /status per
                 # micrograph, the path's natural chunk boundary
                 telemetry.flush_run(run_tlm)
+                # first completed micrograph = warmed up: the
+                # readiness probe goes green (liveness was green
+                # from bind time)
+                tlm_server.set_ready(True)
                 tlm_server.set_status(
                     phase="running",
                     chunks_done=len(counts),
@@ -1787,6 +1866,9 @@ def run_consensus_dir(
                     q_count = len(quarantined) + len(
                         outcomes.quarantined
                     )
+                # first completed chunk = warmed up (the compile is
+                # paid): readiness goes green
+                tlm_server.set_ready(True)
                 tlm_server.set_status(
                     phase="running",
                     chunks_done=len(parts),
@@ -1848,6 +1930,8 @@ def run_consensus_dir(
         if cluster_ctx is not None:
             cluster_ctx.stop()
         telemetry.finish_run(run_tlm)
+        # winding down = draining: readiness off, liveness stays up
+        tlm_server.set_ready(False)
         tlm_server.set_status(phase="finished")
 
 
@@ -1869,6 +1953,7 @@ def iter_consensus_chunks(
     policy: "RetryPolicy | None" = None,
     outcomes: "ChunkOutcomes | None" = None,
     journal: "RunJournal | None" = None,
+    cancel=None,
 ):
     """Run consensus over memory-bounded micrograph chunks.
 
@@ -1908,6 +1993,13 @@ def iter_consensus_chunks(
             ladder status / quarantine records for the caller.
         journal: optional :class:`RunJournal` receiving ladder events
             and quarantine entries as they happen.
+        cancel: optional zero-arg callable polled BEFORE each chunk
+            (and before each per-micrograph fallback attempt); a
+            truthy return raises :class:`ConsensusCancelled` with
+            that value as the reason.  Chunk boundaries are the only
+            cancellation points — a yielded chunk is always complete
+            — which is how the serve daemon implements per-request
+            deadlines and cooperative cancellation.
 
     Yields:
         ``(part, batch, result, extras, seconds)`` per chunk, where
@@ -1976,6 +2068,7 @@ def iter_consensus_chunks(
         """Per-micrograph rung: isolate each micrograph of a failed
         chunk; persistent failures quarantine instead of raising."""
         for name, sets in part:
+            _check_cancel()
             mkey = f"mic:{name}"
             for attempt in range(policy.max_retries + 1):
                 t1 = time.time()
@@ -2010,9 +2103,19 @@ def iter_consensus_chunks(
                 )
                 break
 
+    def _check_cancel():
+        if cancel is None:
+            return
+        reason = cancel()
+        if reason:
+            raise ConsensusCancelled(
+                reason if isinstance(reason, str) else "cancelled"
+            )
+
     i = 0
     attempts = 0  # same-size transient retries on the current chunk
     while i < len(loaded):
+        _check_cancel()
         single = chunk >= len(loaded)
         part = loaded[i : i + chunk]
         cbatch = pad_batch(

@@ -1,0 +1,340 @@
+"""Plan -> execute chunk -> emit: the pure consensus library API.
+
+The ROADMAP item-1 refactor: :func:`run_consensus_dir` interleaves
+three concerns — planning (bucketing micrographs into fixed padded
+shapes and memory-bounded chunks), execution (the jitted batch
+program plus the retry/degradation ladder), and emission (rendering
+BOX artifacts) — with filesystem I/O at every edge.  A long-lived
+server cannot use that: it ingests requests over HTTP, schedules
+chunks from MANY requests into the shared padded capacity buckets so
+warm requests reuse compiled programs, and emits artifacts wherever
+the request says.  This module exposes each stage separately, with
+no filesystem assumptions:
+
+* :func:`plan_request` — pure planning: given already-loaded
+  ``(name, [BoxSet])`` pairs, derive the padded particle-capacity
+  bucket, the memory-bounded chunk size, and the per-chunk name
+  slices.  The plan's :attr:`RequestPlan.bucket_key` is the warm-
+  affinity handle the serve scheduler groups requests by.
+* :func:`execute_request` — a generator over executed chunks,
+  delegating to :func:`iter_consensus_chunks` (the single execution
+  engine: capacity escalation, OOM halving, transient retries,
+  per-micrograph quarantine) with a ``cancel`` hook polled at every
+  chunk boundary (deadlines, client cancellation, drain).
+* :func:`repic_tpu.pipeline.consensus.emit_box_chunk` (re-exported
+  here) — pure emission through a caller-supplied sink.
+
+:func:`consensus_chunk_program` is the per-chunk device program the
+whole stack compiles and reuses — registered with an ``@checked``
+contract so ``repic-tpu check`` verifies the serve path's entry
+point exactly like the CLI's (docs/static_analysis.md).
+
+Execution-state caveat: compiled-program reuse is process-wide (the
+``make_batched_consensus`` cache plus XLA's executable cache), which
+is the entire point of serving from one long-lived process — the
+51.6 s first-call compile is paid once per program signature, and
+``repic_program_cache_{hits,misses}_total`` on ``/metrics`` shows it
+happening.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, fields
+
+import jax
+import jax.numpy as jnp
+
+from repic_tpu.analysis.contracts import Contract, checked, spec
+from repic_tpu.ops.cliques import DEFAULT_THRESHOLD
+from repic_tpu.parallel.batching import bucket_size
+from repic_tpu.parallel.mesh import MICROGRAPH_AXIS
+from repic_tpu.pipeline.consensus import (  # noqa: F401 - re-exports
+    ConsensusCancelled,
+    _auto_chunk,
+    emit_box_chunk,
+    iter_consensus_chunks,
+    make_batched_consensus,
+)
+from repic_tpu.runtime.ladder import DEFAULT_POLICY, RetryPolicy
+
+
+@dataclass(frozen=True)
+class ConsensusOptions:
+    """The content-affecting consensus knobs, as one serializable
+    value — the serve request payload's ``options`` object and the
+    engine's planning input.  Perf-only knobs (mesh, pallas) ride
+    along so a request can pin them, but they stay out of
+    :attr:`RequestPlan.bucket_key` (two requests differing only in
+    perf knobs still share a padded bucket conceptually, though not
+    a compiled program)."""
+
+    threshold: float = DEFAULT_THRESHOLD
+    max_neighbors: int = 16
+    num_particles: int | None = None
+    use_mesh: bool = True
+    spatial: bool | None = None
+    solver: str = "greedy"
+    use_pallas: bool = False
+    strict: bool = False
+    max_retries: int | None = None
+
+    def __post_init__(self):
+        if self.solver not in ("greedy", "lp"):
+            raise ValueError(
+                f"engine solver must be 'greedy' or 'lp', got "
+                f"{self.solver!r} (the host-side 'exact' ladder is a "
+                "run_consensus_dir mode, not a serve mode)"
+            )
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "ConsensusOptions":
+        """Build from an untrusted request payload — unknown keys are
+        a 400, not a silent ignore (a typo'd option must not quietly
+        run with defaults)."""
+        if not isinstance(data, dict):
+            raise ValueError("options must be a JSON object")
+        known = {f.name for f in fields(cls)}
+        unknown = sorted(set(data) - known)
+        if unknown:
+            raise ValueError(
+                f"unknown option(s) {unknown}; known: {sorted(known)}"
+            )
+        return cls(**data)
+
+    def policy(self) -> RetryPolicy:
+        if self.max_retries is None:
+            return DEFAULT_POLICY
+        return RetryPolicy(max_retries=self.max_retries)
+
+
+@dataclass(frozen=True)
+class ChunkPlan:
+    """One fixed-shape chunk: which micrographs, padded to what."""
+
+    index: int
+    names: tuple
+    capacity: int      # padded particle capacity (bucket_size grid)
+    micrographs: int   # padded micrograph count (mesh-axis multiple)
+
+
+@dataclass(frozen=True)
+class RequestPlan:
+    """The pure scheduling view of one consensus request.
+
+    The runtime may still deviate downward (OOM halving shrinks
+    chunks mid-run) — the plan is the scheduler's estimate, the
+    ladder is the truth.
+    """
+
+    options: ConsensusOptions
+    num_pickers: int
+    capacity: int
+    chunk: int
+    n_dev: int
+    chunks: tuple = field(default_factory=tuple)
+
+    @property
+    def bucket_key(self) -> tuple:
+        """The padded-capacity-bucket handle for warm-affinity
+        scheduling: requests sharing it execute the same static
+        program signature (before data-driven escalation), so
+        running them back-to-back skips recompiles."""
+        return (
+            self.num_pickers,
+            self.capacity,
+            self.chunk,
+            self.options.threshold,
+            self.options.solver,
+        )
+
+
+def plan_request(
+    loaded,
+    box_size,
+    options: ConsensusOptions | None = None,
+    *,
+    n_dev: int = 1,
+) -> RequestPlan:
+    """Plan a request over already-loaded ``(name, [BoxSet])`` pairs.
+
+    Pure: no filesystem, no device work — the same
+    ``bucket_size`` / ``_auto_chunk`` arithmetic
+    :func:`iter_consensus_chunks` applies, surfaced as a value the
+    serve scheduler can group requests by before paying anything.
+    """
+    options = options or ConsensusOptions()
+    if not loaded:
+        raise ValueError("plan_request needs >= 1 loaded micrograph")
+    k = len(loaded[0][1])
+    nb = bucket_size(
+        max(bs.n for _, sets in loaded for bs in sets)
+    )
+    chunk = _auto_chunk(len(loaded), k, nb, n_dev)
+    names = [n for n, _ in loaded]
+    single = chunk >= len(loaded)
+    chunks = []
+    for idx, start in enumerate(range(0, len(names), chunk)):
+        part = tuple(names[start : start + chunk])
+        m = (
+            -(-len(part) // n_dev) * n_dev if single else chunk
+        )
+        chunks.append(
+            ChunkPlan(
+                index=idx, names=part, capacity=nb, micrographs=m
+            )
+        )
+    return RequestPlan(
+        options=options,
+        num_pickers=k,
+        capacity=nb,
+        chunk=chunk,
+        n_dev=n_dev,
+        chunks=tuple(chunks),
+    )
+
+
+def execute_request(
+    loaded,
+    box_size,
+    options: ConsensusOptions | None = None,
+    *,
+    n_dev: int = 1,
+    cancel=None,
+    outcomes=None,
+    journal=None,
+):
+    """Execute a planned request chunk by chunk (a generator).
+
+    Yields ``(part, batch, result, packed, seconds)`` per chunk —
+    the ``packed=True`` mode of :func:`iter_consensus_chunks`, so
+    every yield carries the single fetched array
+    :func:`emit_box_chunk` consumes with zero further transfers.
+    ``cancel`` is polled at every chunk boundary; a truthy return
+    raises :class:`ConsensusCancelled` (deadlines, client
+    cancellation, drain).  Failures walk the existing ladder:
+    transient retries, OOM halving, per-micrograph fallback, and
+    quarantine (lenient by default) — one poisoned request cannot
+    take the process down.
+    """
+    options = options or ConsensusOptions()
+    yield from iter_consensus_chunks(
+        loaded,
+        box_size,
+        n_dev=n_dev,
+        threshold=options.threshold,
+        max_neighbors=options.max_neighbors,
+        use_mesh=options.use_mesh,
+        spatial=options.spatial,
+        solver=options.solver,
+        use_pallas=options.use_pallas,
+        packed=True,
+        strict=options.strict,
+        policy=options.policy(),
+        outcomes=outcomes,
+        journal=journal,
+        cancel=cancel,
+    )
+
+
+@checked(Contract(
+    # The serve-path execute entry: one padded chunk (M micrographs,
+    # K pickers, N particle capacity) through the full fused
+    # consensus program.  Mirrors consensus_one's contract with the
+    # leading micrograph axis the chunk scheduler pads/shards.
+    args={
+        "xy": spec("M K N 2"),
+        "conf": spec("M K N"),
+        "mask": spec("M K N", "bool"),
+        "box_size": spec(""),
+    },
+    returns={
+        "rep_xy": spec("M C 2"),
+        "confidence": spec("M C"),
+        "w": spec("M C"),
+        "member_idx": spec("M C K", "int32"),
+        "rep_slot": spec("M C", "int32"),
+        "picked": spec("M C", "bool"),
+        "valid": spec("M C", "bool"),
+        "num_cliques": spec("M", "int32"),
+        "max_adjacency": spec("M", "int32"),
+        "max_partial": spec("M", "int32"),
+    },
+    dims={"M": 2, "K": 3, "N": 8, "C": 64},
+    static={"clique_capacity": 64, "max_neighbors": 4},
+    pspecs={
+        "xy": (MICROGRAPH_AXIS,),
+        "conf": (MICROGRAPH_AXIS,),
+        "mask": (MICROGRAPH_AXIS,),
+    },
+    max_trace_variants=4,
+))
+def consensus_chunk_program(
+    xy: jax.Array,
+    conf: jax.Array,
+    mask: jax.Array,
+    box_size,
+    *,
+    threshold: float = DEFAULT_THRESHOLD,
+    max_neighbors: int = 16,
+    clique_capacity: int = 4096,
+    spatial_grid: int | None = None,
+    cell_capacity: int = 64,
+    solver: str = "greedy",
+    use_pallas: bool = False,
+    partial_capacity: int | None = None,
+):
+    """One chunk's device program at an explicit static config.
+
+    The compiled unit the serve daemon's warm path reuses across
+    requests (one executable per static signature + input shape —
+    the cache the hit/miss counters on ``/metrics`` observe).  Thin
+    by design: resolves to the same memoized jit wrapper the batch
+    path uses, so calling it warms exactly what production runs.
+    """
+    fn = make_batched_consensus(
+        threshold=threshold,
+        max_neighbors=max_neighbors,
+        clique_capacity=clique_capacity,
+        mesh=None,
+        spatial_grid=spatial_grid,
+        cell_capacity=cell_capacity,
+        solver=solver,
+        use_pallas=use_pallas,
+        partial_capacity=partial_capacity,
+    )
+    return fn(xy, conf, mask, box_size)
+
+
+def warmup(
+    num_pickers: int = 2,
+    capacity: int = 64,
+    *,
+    box_size: float = 180.0,
+) -> dict:
+    """Compile-and-run one tiny (all-padding) chunk program.
+
+    The serve daemon's readiness gate: proves the backend is up and
+    the fused program compiles BEFORE the first request lands, so a
+    broken XLA install turns the readiness probe red instead of
+    failing (or stalling) a user's job.  The input is fully masked —
+    zero cliques, zero work — so the cost is one trace+compile of
+    the smallest bucket.  Returns a summary for the serve journal.
+    """
+    import time
+
+    t0 = time.time()
+    k, n = int(num_pickers), int(capacity)
+    res = consensus_chunk_program(
+        jnp.zeros((1, k, n, 2), jnp.float32),
+        jnp.zeros((1, k, n), jnp.float32),
+        jnp.zeros((1, k, n), bool),
+        jnp.float32(box_size),
+        max_neighbors=4,
+        clique_capacity=64,
+    )
+    jax.block_until_ready(res.picked)
+    return {
+        "num_pickers": k,
+        "capacity": n,
+        "compile_s": round(time.time() - t0, 3),
+    }

@@ -277,9 +277,19 @@ class ClusterContext:
     unit-testable standalone against a tmp coordination directory.
     """
 
-    def __init__(self, cfg: ClusterConfig, out_dir: str):
+    def __init__(self, cfg: ClusterConfig, out_dir: str,
+                 clock=time.time):
         ident = resolve_identity()
         self.cfg = cfg
+        # injectable clock: every timestamp this context WRITES
+        # (heartbeats, leases, fences) and every liveness/deadline
+        # judgment it MAKES reads this instead of time.time, so
+        # tests drive heartbeat aging and harvest windows
+        # deterministically instead of sleeping against wall time
+        # (the PR 7 full-suite flake).  Production default is
+        # time.time; records stay comparable across hosts because
+        # every host defaults to it.
+        self._clock = clock
         self.host = sanitize_host_id(
             cfg.host_id if cfg.host_id else ident[0]
         )
@@ -299,6 +309,7 @@ class ClusterContext:
         self._lease_epoch = 0
         self._seq = 0
         self._stop = threading.Event()
+        self._wake = threading.Event()
         self._thread: threading.Thread | None = None
         # incremental merged-journal view for the harvest poll loop
         self._merged = MergedJournalReader(out_dir)
@@ -324,7 +335,7 @@ class ClusterContext:
                     "rank": self.rank,
                     "pid": os.getpid(),
                     "seq": self._seq,
-                    "ts": time.time(),
+                    "ts": self._clock(),
                     "stopped": stopped,
                 },
                 f,
@@ -335,7 +346,14 @@ class ClusterContext:
         ).inc()
 
     def _beat_loop(self) -> None:
-        while not self._stop.wait(self.cfg.heartbeat_interval_s):
+        while True:
+            # interval timer OR an explicit request_beat() wake —
+            # the wake lets tests force a renewal deterministically
+            # instead of sleeping multiples of the interval
+            self._wake.wait(self.cfg.heartbeat_interval_s)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
             try:
                 self.beat()
             except Exception:  # pragma: no cover - best-effort renew
@@ -343,6 +361,11 @@ class ClusterContext:
                 # tick retries, and a persistent failure surfaces as
                 # this host going suspect (the safe direction)
                 pass
+
+    def request_beat(self) -> None:
+        """Wake the renewal thread for an immediate heartbeat (the
+        deterministic test hook; harmless no-op in production)."""
+        self._wake.set()
 
     def start(self) -> "ClusterContext":
         """Write the first heartbeat and start the renewal thread.
@@ -372,6 +395,7 @@ class ClusterContext:
         """Stop renewals; a clean stop records ``stopped`` so peers
         may reassign any incomplete lease without a timeout wait."""
         self._stop.set()
+        self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
@@ -409,13 +433,16 @@ class ClusterContext:
                     "host": self.host,
                     "names": list(self._lease_names),
                     "epoch": self._lease_epoch,
-                    "ts": time.time(),
+                    "ts": self._clock(),
                 },
                 f,
             )
 
     def liveness(self) -> dict[str, HostState]:
-        view = read_liveness(self.coord_dir, self.cfg.host_timeout_s)
+        view = read_liveness(
+            self.coord_dir, self.cfg.host_timeout_s,
+            now=self._clock(),
+        )
         live = sum(1 for s in view.values() if s.rung == HOST_LIVE)
         suspect = sum(
             1 for s in view.values() if s.rung == HOST_SUSPECT
@@ -520,7 +547,7 @@ class ClusterContext:
                 {
                     "host": host,
                     "fenced_by": self.host,
-                    "ts": time.time(),
+                    "ts": self._clock(),
                 },
             ):
                 fenced_by_me = True
@@ -578,7 +605,7 @@ class ClusterContext:
                 self.cfg.host_timeout_s
                 + 2 * self.cfg.heartbeat_interval_s
             )
-        deadline = time.time() + wait_s
+        deadline = self._clock() + wait_s
         baseline: dict[str, tuple] = {}
         confirmed_alive: set = set()
         while True:
@@ -657,7 +684,7 @@ class ClusterContext:
                     h, names, journal, view, require_fence=True
                 ):
                     claim.extend(names)
-            expired = time.time() >= deadline
+            expired = self._clock() >= deadline
             if not claim and unheld and wait_s > 0 and expired:
                 # the wait window gave an unstarted host every chance
                 # to check in — adopt the ownerless work

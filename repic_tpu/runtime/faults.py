@@ -32,6 +32,27 @@ site              raised at the matching call site
                   ``runtime.cluster.try_claim``; a firing makes the
                   claim report a lost race (as if a concurrent
                   host created the record first)
+``request_storm`` no exception — polled at serve-daemon admission
+                  (``serve.jobs.JobQueue.submit``); a firing makes
+                  admission behave as if the bounded queue were
+                  full (429 + ``Retry-After``) without having to
+                  race real submissions
+``slow_client``   no exception — polled where the serve daemon
+                  writes a response body; a firing sends a partial
+                  payload and aborts the connection, the
+                  deterministic stand-in for a client that stalled
+                  mid-read and vanished
+``deadline_exceeded`` no exception — polled by the serve worker's
+                  per-request cancel check at every chunk boundary;
+                  a firing reports the request's deadline as
+                  expired regardless of the clock
+``server_crash``  no exception — polled by
+                  ``serve.daemon`` crash points, which terminate
+                  the process with ``os._exit(SERVE_CRASH_EXIT_
+                  CODE)``: an abrupt daemon loss (no journal
+                  close, no drain).  Keys: ``accept:<job>``,
+                  ``run:<job>``, ``run:<job>:chunk:<i>``,
+                  ``finish:<job>``
 ================= ==================================================
 
 Injection is purely count-based (no randomness, no clocks): a
@@ -68,6 +89,10 @@ KNOWN_SITES = (
     "host_crash",
     "heartbeat_stall",
     "lease_race",
+    "request_storm",
+    "slow_client",
+    "deadline_exceeded",
+    "server_crash",
 )
 
 

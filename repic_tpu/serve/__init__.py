@@ -1,0 +1,47 @@
+"""Consensus-as-a-service: the ``repic-tpu serve`` daemon.
+
+ROADMAP item 1: a long-lived multi-tenant server in front of the
+consensus core, so requests reuse warm compiled programs (0.55 s)
+instead of each paying the cold-start compile (51.6 s on the last
+healthy TPU window).  The layering follows the TensorFlow system
+paper (arXiv:1605.08695): the dataflow core
+(:mod:`repic_tpu.pipeline.engine`, the pure plan -> execute chunk ->
+emit API) knows nothing about HTTP, queues, or deadlines; this
+package is the serving/coordination layer above it, and its value is
+defined by how it behaves when things go wrong:
+
+* **admission control** — a bounded job queue; overload is an
+  explicit 429 with ``Retry-After``, never an unbounded backlog
+  (:class:`repic_tpu.serve.jobs.JobQueue`).
+* **deadlines** — per-request budgets enforced by cooperative
+  cancellation at chunk boundaries (a yielded chunk is always
+  complete), journaled as ``deadline_exceeded``.
+* **request isolation** — each job runs through the existing
+  retry/quarantine ladder; one poisoned request degrades to
+  quarantined micrographs, it cannot kill the daemon.
+* **circuit breaker** — repeated job failures open the breaker:
+  submissions get 503 + ``Retry-After`` until a cooldown probe
+  succeeds (:class:`repic_tpu.serve.jobs.CircuitBreaker`).
+* **graceful drain** — SIGTERM stops admission (readiness probe goes
+  red), finishes the in-flight job inside a grace budget, and leaves
+  queued work journaled for the next start.
+* **crash safety** — every accepted request is journaled
+  (``_serve_journal.jsonl``, the PR 2 journal idioms) before the
+  client sees 202; a restarted daemon re-queues every non-terminal
+  job, and in-flight jobs resume from their per-job run journal with
+  completed micrographs skipped — zero accepted work lost.
+
+Deterministic failure testing uses four fault sites
+(:mod:`repic_tpu.runtime.faults`): ``request_storm``,
+``slow_client``, ``deadline_exceeded``, ``server_crash``.
+
+Operator docs: docs/serving.md.
+"""
+
+from repic_tpu.serve.jobs import (  # noqa: F401
+    AdmissionError,
+    CircuitBreaker,
+    Job,
+    JobQueue,
+    ServeJournal,
+)

@@ -1,0 +1,679 @@
+"""The ``repic-tpu serve`` daemon: HTTP surface + worker + recovery.
+
+Extends the PR 7 status server (:mod:`repic_tpu.telemetry.server`)
+with the job API, and runs one worker thread that drives accepted
+jobs through the pure engine (:mod:`repic_tpu.pipeline.engine`).
+One worker is deliberate: the device is a serial resource, and the
+whole point of the daemon is that SEQUENTIAL jobs reuse warm
+compiled programs — concurrency lives in the HTTP threads (cheap,
+stdlib) and on the device (batch/mesh parallelism inside a chunk).
+
+Endpoint surface (all JSON unless noted)::
+
+    POST   /v1/jobs                submit; 202 | 400 | 429 | 503
+    GET    /v1/jobs                job summaries
+    GET    /v1/jobs/<id>           full job document
+    DELETE /v1/jobs/<id>           cancel (cooperative when running)
+    GET    /v1/jobs/<id>/artifacts           artifact name list
+    GET    /v1/jobs/<id>/artifacts/<name>    one BOX file (text)
+    GET    /metrics /status /healthz[/live|/ready]   (inherited)
+
+Failure semantics are the contract (docs/serving.md): overload is
+429 + ``Retry-After``; a broken backend opens the circuit breaker
+(503); deadlines cancel cooperatively at chunk boundaries; SIGTERM
+drains gracefully; and a crash at ANY point loses no accepted job —
+the request journal replays them on the next start, with in-flight
+jobs resuming past their already-completed micrographs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+
+from repic_tpu import telemetry
+from repic_tpu.runtime import faults
+from repic_tpu.runtime.atomic import atomic_write
+from repic_tpu.serve.jobs import (
+    JOB_CANCELLED,
+    JOB_DEADLINE_EXCEEDED,
+    JOB_FAILED,
+    JOB_FINISHED,
+    JOB_QUEUED,
+    AdmissionError,
+    CircuitBreaker,
+    Job,
+    JobQueue,
+    ServeJournal,
+    crash_point,
+)
+from repic_tpu.telemetry import events as tlm_events
+from repic_tpu.telemetry import server as tlm_server
+
+SERVE_INFO_NAME = "_serve.json"
+
+_log = tlm_events.get_logger("serve")
+
+_REQUESTS = telemetry.counter(
+    "repic_serve_requests_total",
+    "HTTP requests handled by the serve job API (by route)",
+)
+_JOB_SECONDS = telemetry.histogram(
+    "repic_serve_job_seconds",
+    "wall-clock seconds per executed serve job",
+)
+
+
+def validate_submission(body: bytes):
+    """Parse + validate a POST /v1/jobs body.
+
+    Returns ``(request, options, deadline_s, bucket_hint)`` or
+    raises ``ValueError`` with a client-readable message (mapped to
+    400 — a malformed request is the client's bug, never a 5xx).
+    """
+    from repic_tpu.pipeline.engine import ConsensusOptions
+
+    try:
+        data = json.loads(body.decode("utf-8") or "{}")
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ValueError(f"invalid JSON body: {e}") from None
+    if not isinstance(data, dict):
+        raise ValueError("request body must be a JSON object")
+    known = {
+        "in_dir", "box_size", "options", "deadline_s", "bucket_hint"
+    }
+    unknown = sorted(set(data) - known)
+    if unknown:
+        raise ValueError(
+            f"unknown field(s) {unknown}; known: {sorted(known)}"
+        )
+    in_dir = data.get("in_dir")
+    if not isinstance(in_dir, str) or not in_dir:
+        raise ValueError("in_dir (string) is required")
+    if not os.path.isdir(in_dir):
+        raise ValueError(f"in_dir {in_dir!r} is not a directory")
+    box_size = data.get("box_size")
+    sizes = (
+        box_size if isinstance(box_size, list) else [box_size]
+    )
+    if not sizes or not all(
+        isinstance(b, (int, float)) and b > 0 for b in sizes
+    ):
+        raise ValueError("box_size must be a positive number "
+                         "(or a per-picker list of them)")
+    options = ConsensusOptions.from_dict(data.get("options") or {})
+    deadline_s = data.get("deadline_s")
+    if deadline_s is not None:
+        if not isinstance(deadline_s, (int, float)) or deadline_s <= 0:
+            raise ValueError("deadline_s must be a positive number")
+        deadline_s = float(deadline_s)
+    bucket_hint = data.get("bucket_hint")
+    if bucket_hint is not None:
+        if not isinstance(bucket_hint, int) or bucket_hint < 1:
+            raise ValueError("bucket_hint must be a positive int")
+    request = {
+        "in_dir": os.path.abspath(in_dir),
+        "box_size": box_size,
+        "options": data.get("options") or {},
+    }
+    return request, options, deadline_s, bucket_hint
+
+
+class ServeServer(tlm_server.StatusServer):
+    """StatusServer + the ``/v1/jobs`` API (one override point)."""
+
+    def __init__(self, daemon: "ConsensusDaemon", port: int,
+                 host: str):
+        super().__init__(port=port, host=host)
+        self.daemon = daemon
+
+    # one handler thread per request (ThreadingHTTPServer); every
+    # mutation goes through the queue's lock + journal
+    def handle_request(self, handler, method, path, body) -> bool:
+        if not path.startswith("/v1/jobs"):
+            return False
+        parts = [p for p in path.split("/") if p][2:]  # after v1/jobs
+        try:
+            if method == "POST" and not parts:
+                self._submit(handler, body)
+            elif method == "GET" and not parts:
+                _REQUESTS.inc(route="jobs_list")
+                docs = sorted(
+                    (j.doc() for j in self.daemon.queue.jobs()),
+                    key=lambda d: d["accepted_ts"],
+                )
+                self._json(handler, 200, {"jobs": docs})
+            elif len(parts) == 1:
+                self._one_job(handler, method, parts[0])
+            elif len(parts) >= 2 and parts[1] == "artifacts":
+                self._artifacts(handler, method, parts)
+            else:
+                self._json(handler, 404, {"error": "not found"})
+        except BrokenPipeError:
+            pass  # client vanished mid-response; nothing to clean
+        return True
+
+    def _json(self, handler, code: int, doc: dict,
+              headers: dict | None = None):
+        handler._send(
+            code, "application/json",
+            json.dumps(doc, default=str, sort_keys=True) + "\n",
+            headers,
+        )
+
+    def _submit(self, handler, body: bytes):
+        _REQUESTS.inc(route="jobs_submit")
+        try:
+            request, options, deadline_s, hint = validate_submission(
+                body
+            )
+        except ValueError as e:
+            self._json(handler, 400, {"error": str(e)})
+            return
+        if deadline_s is None:
+            deadline_s = self.daemon.default_deadline_s
+        try:
+            job = self.daemon.queue.submit(
+                request, deadline_s=deadline_s, bucket_hint=hint
+            )
+        except AdmissionError as e:
+            self._json(
+                handler,
+                e.http_status,
+                {"error": e.reason,
+                 "retry_after_s": e.retry_after_s},
+                {"Retry-After": e.retry_after_s},
+            )
+            return
+        self.daemon.publish_status()
+        self._json(handler, 202, job.doc())
+
+    def _one_job(self, handler, method, job_id):
+        job = self.daemon.queue.get(job_id)
+        if job is None:
+            _REQUESTS.inc(route="jobs_get")
+            self._json(handler, 404, {"error": f"no job {job_id}"})
+        elif method == "DELETE":
+            _REQUESTS.inc(route="jobs_cancel")
+            self.daemon.queue.cancel(job_id)
+            self.daemon.publish_status()
+            self._json(handler, 202, job.doc())
+        elif method == "GET":
+            _REQUESTS.inc(route="jobs_get")
+            self._json(handler, 200, job.doc())
+        else:
+            self._json(handler, 405, {"error": "method not allowed"})
+
+    def _artifacts(self, handler, method, parts):
+        _REQUESTS.inc(route="artifacts")
+        job = self.daemon.queue.get(parts[0])
+        if job is None or method != "GET":
+            code = 404 if job is None else 405
+            self._json(handler, code, {"error": "not found"})
+            return
+        out_dir = self.daemon.job_dir(job.id)
+        names = sorted(
+            f for f in (
+                os.listdir(out_dir)
+                if os.path.isdir(out_dir)
+                else ()
+            )
+            if f.endswith(".box")
+        )
+        if len(parts) == 2:
+            self._json(
+                handler, 200,
+                {"job": job.id, "artifacts": names},
+            )
+            return
+        name = parts[2]
+        if name not in names:  # also forecloses path traversal
+            self._json(handler, 404, {"error": f"no artifact {name}"})
+            return
+        with open(os.path.join(out_dir, name)) as f:
+            content = f.read()
+        if faults.check("slow_client", f"{job.id}:{name}"):
+            # the deterministic slow/vanished client: promise the
+            # full payload, deliver half, drop the connection.  The
+            # daemon must shrug (this handler thread only) — the
+            # job, its artifacts, and every other connection are
+            # untouched, and the client simply retries.
+            data = content.encode("utf-8")
+            handler.send_response(200)
+            handler.send_header("Content-Type", "text/plain")
+            handler.send_header("Content-Length", str(len(data)))
+            handler.end_headers()
+            handler.wfile.write(data[: len(data) // 2])
+            handler.wfile.flush()
+            handler.connection.close()
+            return
+        handler._send(200, "text/plain; charset=utf-8", content)
+
+
+class ConsensusDaemon:
+    """One serve instance: queue + journal + worker + HTTP server."""
+
+    def __init__(
+        self,
+        work_dir: str,
+        *,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        queue_limit: int = 8,
+        default_deadline_s: float | None = None,
+        drain_grace_s: float = 30.0,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 30.0,
+        warmup: bool = True,
+        clock=time.time,
+    ):
+        self.work_dir = os.path.abspath(work_dir)
+        self.default_deadline_s = default_deadline_s
+        self.drain_grace_s = drain_grace_s
+        self.do_warmup = warmup
+        self._clock = clock
+        os.makedirs(self.work_dir, exist_ok=True)
+        self.journal = ServeJournal(self.work_dir)
+        self.queue = JobQueue(
+            queue_limit,
+            self.journal,
+            CircuitBreaker(
+                threshold=breaker_threshold,
+                cooldown_s=breaker_cooldown_s,
+                clock=clock,
+            ),
+            clock=clock,
+        )
+        self.server = ServeServer(self, port, host)
+        self._stop = threading.Event()
+        self._drain_deadline: float | None = None
+        self._worker: threading.Thread | None = None
+
+    # -- lifecycle ----------------------------------------------------
+
+    def job_dir(self, job_id: str) -> str:
+        return os.path.join(self.work_dir, "jobs", job_id)
+
+    def start(self) -> "ConsensusDaemon":
+        recovered = self.journal.recover()
+        self.server.start()
+        self.journal.record_event(
+            "server_started",
+            pid=os.getpid(),
+            port=self.server.port,
+            recovered=[j.id for j in recovered],
+        )
+        for job in recovered:
+            self.queue.adopt(job)
+        if recovered:
+            _log.info(
+                f"recovered {len(recovered)} journaled job(s) "
+                "from the previous generation"
+            )
+        # discovery file: ephemeral-port consumers (CI, operators)
+        # read the bound port from here instead of parsing stderr
+        with atomic_write(
+            os.path.join(self.work_dir, SERVE_INFO_NAME)
+        ) as f:
+            json.dump(
+                {
+                    "pid": os.getpid(),
+                    "host": self.server.host,
+                    "port": self.server.port,
+                    "started_ts": self._clock(),
+                },
+                f,
+            )
+        self.publish_status()
+        self._worker = threading.Thread(
+            target=self._worker_loop,
+            name="repic-serve-worker",
+            daemon=True,
+        )
+        self._worker.start()
+        return self
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT -> graceful drain (main-thread only)."""
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *_: self._stop.set())
+
+    def run_until_signalled(self) -> None:
+        while not self._stop.wait(0.2):
+            pass
+        self.drain()
+
+    def request_stop(self) -> None:
+        self._stop.set()
+
+    def begin_drain(self) -> int:
+        """Phase 1 of the graceful shutdown: readiness goes red,
+        admission closes (503 ``draining``), the in-flight job gets
+        ``drain_grace_s`` before a cooperative cancel at its next
+        chunk boundary.  Queued jobs stay journaled for the next
+        generation.  The HTTP server keeps answering — health
+        checkers and pollers must see the drain, not a dead port."""
+        tlm_server.set_ready(False)
+        self._drain_deadline = self._clock() + self.drain_grace_s
+        left = self.queue.begin_drain()
+        self.journal.record_event("drain_begin", queued=left)
+        _log.info(f"draining: {left} queued job(s) journaled for "
+                  "the next start")
+        return left
+
+    def finish_drain(self) -> None:
+        """Phase 2: wait out the worker, then stop serving."""
+        if self._worker is not None:
+            self._worker.join(timeout=self.drain_grace_s + 30.0)
+        self.journal.record_event("drain_complete")
+        self.server.stop()
+        self.journal.close()
+
+    def drain(self) -> None:
+        self.begin_drain()
+        self.finish_drain()
+
+    def publish_status(self) -> None:
+        by_state: dict[str, int] = {}
+        for j in self.queue.jobs():
+            by_state[j.state] = by_state.get(j.state, 0) + 1
+        tlm_server.set_status(
+            service="serve",
+            work_dir=self.work_dir,
+            jobs=by_state,
+            draining=self.queue.draining,
+            breaker=self.queue.breaker.state,
+        )
+
+    # -- worker -------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        if self.do_warmup:
+            try:
+                from repic_tpu.pipeline import engine
+
+                info = engine.warmup()
+                self.journal.record_event("warmup", **info)
+                tlm_server.set_ready(True)
+            except Exception as e:  # noqa: BLE001 - stay alive
+                # liveness stays green (the operator can reach
+                # /status); readiness stays red — the standard
+                # "up but unservable" posture
+                self.journal.record_event(
+                    "warmup_failed", error=self.queue.error_doc(e)
+                )
+                _log.error(f"warmup failed: {e}")
+        else:
+            tlm_server.set_ready(True)
+        last_bucket = None
+        while True:
+            job = self.queue.next_job(0.2, last_bucket)
+            if job is None:
+                if self.queue.draining:
+                    return
+                continue
+            try:
+                last_bucket = self._run_job(job) or last_bucket
+            except Exception as e:  # noqa: BLE001 - last resort
+                # _run_job isolates job failures itself; anything
+                # escaping it (journal write failing in
+                # mark_running, a broken queue) must still not kill
+                # the sole worker — a dead worker with a live HTTP
+                # front end would 202 jobs into a queue nothing
+                # drains, with every health probe green
+                try:
+                    job.error = self.queue.error_doc(e)
+                    self.queue.finish(
+                        job, JOB_FAILED, error=job.error
+                    )
+                except Exception:  # the journal itself may be down
+                    job.state = JOB_FAILED
+                self.queue.breaker.record_failure()
+                _log.error(f"worker error on job {job.id}: {e}")
+            self.publish_status()
+
+    def _cancel_check(self, job: Job):
+        """The per-request cancel hook, polled at chunk boundaries."""
+
+        def check():
+            if faults.check("deadline_exceeded", job.id):
+                job.cancel_reason = (
+                    "deadline exceeded (injected fault)"
+                )
+            elif job.cancel_requested:
+                job.cancel_reason = "cancelled by client"
+            elif (
+                job.deadline_ts is not None
+                and self._clock() > job.deadline_ts
+            ):
+                budget = job.deadline_ts - job.accepted_ts
+                job.cancel_reason = (
+                    f"deadline exceeded ({budget:.1f}s budget)"
+                )
+            elif (
+                self._drain_deadline is not None
+                and self._clock() > self._drain_deadline
+            ):
+                job.cancel_reason = "draining past grace"
+            return job.cancel_reason
+
+        return check
+
+    def _run_job(self, job: Job):
+        """Execute one job through the engine; returns the warmed
+        bucket key (or None).  Every exit path records a journal
+        state — crash points between them are what the recovery
+        tests exercise."""
+        import numpy as np
+
+        from repic_tpu.pipeline import engine
+        from repic_tpu.runtime.journal import RunJournal, error_info
+        from repic_tpu.runtime.ladder import ChunkOutcomes
+        from repic_tpu.utils import box_io
+
+        self.queue.mark_running(job)
+        self.publish_status()
+        crash_point(f"run:{job.id}")
+        t0 = self._clock()
+        # a job that aged out while queued never touches the device
+        if (
+            job.deadline_ts is not None
+            and self._clock() > job.deadline_ts
+        ):
+            job.reason = "deadline exceeded while queued"
+            self.queue.finish(
+                job, JOB_DEADLINE_EXCEEDED, reason=job.reason
+            )
+            return None
+        options = None
+        bucket = None
+        out_dir = self.job_dir(job.id)
+        rt = None
+        run_journal = None
+        try:
+            options = engine.ConsensusOptions.from_dict(
+                job.request.get("options") or {}
+            )
+            in_dir = job.request["in_dir"]
+            box_size = job.request["box_size"]
+            pickers = box_io.discover_picker_dirs(in_dir)
+            if not pickers:
+                raise ValueError(
+                    f"no picker subdirectories in {in_dir}"
+                )
+            names = box_io.micrograph_names(
+                os.path.join(in_dir, pickers[0])
+            )
+            os.makedirs(out_dir, exist_ok=True)
+            run_config = {
+                "in_dir": in_dir,
+                "box_size": np.asarray(box_size).tolist(),
+                "threshold": options.threshold,
+                "num_particles": options.num_particles,
+                "solver": options.solver,
+                "pickers": pickers,
+                "names": names,
+            }
+            # resume semantics give crash recovery its zero-loss
+            # guarantee: a re-run of a journaled in-flight job skips
+            # every micrograph whose outcome + artifact survived
+            journal = run_journal = RunJournal.open(
+                out_dir, run_config, resume=True
+            )
+            rt = telemetry.start_run(
+                out_dir,
+                run_id=f"serve-{job.id}",
+            )
+            already = set()
+            if journal.resumed:
+                latest = journal.latest()
+                for nm in journal.done_names():
+                    out_name = latest[nm].get("out", nm + ".box")
+                    if os.path.exists(
+                        os.path.join(out_dir, out_name)
+                    ):
+                        already.add(nm)
+            counts: dict[str, int] = {}
+            quarantined: dict[str, dict] = {}
+            loaded = []
+            for nm in names:
+                if nm in already:
+                    continue
+                try:
+                    sets = box_io.load_micrograph_set(
+                        in_dir, pickers, nm
+                    )
+                except (box_io.BoxParseError, OSError) as e:
+                    if options.strict:
+                        raise
+                    info = error_info(
+                        e, path=getattr(e, "path", None)
+                    )
+                    quarantined[nm] = info
+                    journal.record(
+                        nm, "quarantined", error=info, stage="load"
+                    )
+                    continue
+                if sets is None:
+                    box_io.write_empty_box(
+                        os.path.join(out_dir, nm + ".box")
+                    )
+                    journal.record(
+                        nm, "skipped", out=nm + ".box"
+                    )
+                    counts[nm] = 0
+                    continue
+                loaded.append((nm, sets))
+            n_dev = 1
+            if options.use_mesh:
+                import jax
+
+                n_dev = len(jax.devices())
+            outcomes = ChunkOutcomes()
+            if loaded:
+                plan = engine.plan_request(
+                    loaded, box_size, options, n_dev=n_dev
+                )
+                bucket = plan.bucket_key
+                job.progress = {
+                    "chunks_total": len(plan.chunks),
+                    "chunks_done": 0,
+                    "capacity": plan.capacity,
+                    "micrographs_total": len(names),
+                    "micrographs_done": len(already) + len(counts),
+                }
+
+                def _sink(fname, content):
+                    with atomic_write(
+                        os.path.join(out_dir, fname)
+                    ) as f:
+                        f.write(content)
+
+                chunks = engine.execute_request(
+                    loaded,
+                    box_size,
+                    options,
+                    n_dev=n_dev,
+                    cancel=self._cancel_check(job),
+                    outcomes=outcomes,
+                    journal=journal,
+                )
+                for i, (part, cbatch, _res, packed, secs) in (
+                    enumerate(chunks)
+                ):
+                    counts.update(
+                        engine.emit_box_chunk(
+                            cbatch, packed, box_size,
+                            num_particles=options.num_particles,
+                            sink=_sink,
+                        )
+                    )
+                    for nm, _sets in part:
+                        journal.record(
+                            nm,
+                            outcomes.status.get(nm, "ok"),
+                            wall_s=round(secs / max(len(part), 1), 6),
+                            solver=options.solver,
+                            particles=counts.get(nm),
+                            out=nm + ".box",
+                        )
+                    job.progress["chunks_done"] = i + 1
+                    job.progress["micrographs_done"] = (
+                        len(already) + len(counts)
+                    )
+                    telemetry.flush_run(rt)
+                    crash_point(f"run:{job.id}:chunk:{i}")
+            quarantined.update(outcomes.quarantined)
+            job.result = {
+                "micrographs": len(names),
+                "resumed_micrographs": len(already),
+                "particles": int(sum(counts.values())),
+                "quarantined": len(quarantined),
+                "out_dir": out_dir,
+                "journal": journal.summary(),
+            }
+            journal.close()
+            crash_point(f"finish:{job.id}")
+            wall = self._clock() - t0
+            _JOB_SECONDS.observe(wall)
+            self.queue.finish(
+                job, JOB_FINISHED,
+                wall_s=round(wall, 3),
+                particles=job.result["particles"],
+                quarantined=job.result["quarantined"],
+            )
+            self.queue.breaker.record_success()
+            return bucket
+        except engine.ConsensusCancelled:
+            # cooperative stop at a chunk boundary: every completed
+            # chunk's artifacts + journal records are already on
+            # disk, so a later re-submission (or drain restart)
+            # resumes instead of redoing
+            reason = job.cancel_reason or "cancelled"
+            job.reason = reason
+            if reason.startswith("deadline"):
+                state = JOB_DEADLINE_EXCEEDED
+            elif reason.startswith("draining"):
+                # not terminal: back to queued, journaled for the
+                # next generation to pick up where this one left off
+                state = JOB_QUEUED
+            else:
+                state = JOB_CANCELLED
+            self.queue.finish(job, state, reason=reason)
+            return bucket
+        except Exception as e:  # noqa: BLE001 - isolation boundary
+            # request isolation: a poisoned job FAILS (journaled,
+            # visible to its client, counted by the breaker); the
+            # daemon and every other job keep going
+            job.error = self.queue.error_doc(e)
+            self.queue.finish(job, JOB_FAILED, error=job.error)
+            self.queue.breaker.record_failure()
+            _log.error(f"job {job.id} failed: {e}")
+            return bucket
+        finally:
+            if run_journal is not None:
+                run_journal.close()
+            telemetry.finish_run(rt)

@@ -1,0 +1,546 @@
+"""Serve-side job model: journal, bounded queue, circuit breaker.
+
+Everything here is host-only stdlib (no jax import): admission
+decisions must stay cheap and testable without a backend.  The
+daemon's HTTP layer (:mod:`repic_tpu.serve.daemon`) owns sockets and
+the worker thread; this module owns the state machine:
+
+    queued -> running -> finished | failed | cancelled
+                         | deadline_exceeded
+
+plus the crash-safe request journal that makes the state machine
+survive process death.  The journal reuses the PR 2 run-journal
+idioms — append-only JSONL, flushed per record, torn-trailing-line
+tolerant reads — because a restarted daemon reading its own journal
+after a crash is exactly the case those idioms exist for.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from repic_tpu import telemetry
+from repic_tpu.runtime import faults
+from repic_tpu.runtime.journal import _read_entries, error_info
+
+SERVE_JOURNAL_NAME = "_serve_journal.jsonl"
+
+#: exit status of a ``server_crash`` fault firing — distinguishable
+#: from ordinary failures (and from the cluster's host_crash 23) in
+#: the chaos test harness
+SERVE_CRASH_EXIT_CODE = 24
+
+JOB_QUEUED = "queued"
+JOB_RUNNING = "running"
+JOB_FINISHED = "finished"
+JOB_FAILED = "failed"
+JOB_CANCELLED = "cancelled"
+JOB_DEADLINE_EXCEEDED = "deadline_exceeded"
+
+TERMINAL_STATES = frozenset(
+    (JOB_FINISHED, JOB_FAILED, JOB_CANCELLED, JOB_DEADLINE_EXCEEDED)
+)
+
+_REJECTED = telemetry.counter(
+    "repic_serve_rejected_total",
+    "serve submissions rejected at admission (by reason)",
+)
+_ADMITTED = telemetry.counter(
+    "repic_serve_admitted_total",
+    "serve submissions accepted into the bounded queue",
+)
+_DEPTH = telemetry.gauge(
+    "repic_serve_queue_depth",
+    "jobs waiting in the serve queue (excludes the running job)",
+)
+_JOBS = telemetry.counter(
+    "repic_serve_jobs_total",
+    "serve jobs reaching a terminal state (by state)",
+)
+_BREAKER_STATE = telemetry.gauge(
+    "repic_serve_breaker_state",
+    "circuit breaker state: 0 closed, 1 open, 2 half-open",
+)
+_BREAKER_TRIPS = telemetry.counter(
+    "repic_serve_breaker_trips_total",
+    "circuit breaker open transitions",
+)
+
+
+def crash_point(point: str) -> None:
+    """``server_crash`` fault site: kill THIS process abruptly
+    (``os._exit`` — no journal close, no drain, no Python cleanup),
+    the deterministic stand-in for a daemon loss.  Keys:
+    ``accept:<job>``, ``run:<job>``, ``run:<job>:chunk:<i>``,
+    ``finish:<job>``."""
+    if faults.check("server_crash", point):
+        os._exit(SERVE_CRASH_EXIT_CODE)
+
+
+class AdmissionError(Exception):
+    """A submission the daemon refuses to take, mapped to HTTP.
+
+    ``http_status`` 429 (queue full) or 503 (circuit open /
+    draining); ``retry_after_s`` becomes the ``Retry-After`` header
+    so well-behaved clients back off instead of hammering."""
+
+    def __init__(self, http_status: int, reason: str,
+                 retry_after_s: float):
+        super().__init__(reason)
+        self.http_status = int(http_status)
+        self.reason = reason
+        self.retry_after_s = max(1, int(round(retry_after_s)))
+
+
+@dataclass
+class Job:
+    """One accepted consensus request and its live state."""
+
+    id: str
+    request: dict                  # validated submission payload
+    accepted_ts: float
+    state: str = JOB_QUEUED
+    deadline_ts: float | None = None
+    bucket_hint: int | None = None
+    started_ts: float | None = None
+    finished_ts: float | None = None
+    error: dict | None = None
+    reason: str | None = None      # cancel/deadline detail
+    resumed: bool = False          # re-queued across a daemon restart
+    cancel_requested: bool = False
+    cancel_reason: str | None = None
+    skipped: int = 0               # affinity-scheduling fairness cap
+    progress: dict = field(default_factory=dict)
+    result: dict = field(default_factory=dict)
+
+    def doc(self) -> dict:
+        """The ``GET /v1/jobs/<id>`` document."""
+        out = {
+            "id": self.id,
+            "state": self.state,
+            "request": self.request,
+            "accepted_ts": self.accepted_ts,
+            "started_ts": self.started_ts,
+            "finished_ts": self.finished_ts,
+            "resumed": self.resumed,
+        }
+        if self.deadline_ts is not None:
+            out["deadline_ts"] = self.deadline_ts
+        if self.progress:
+            out["progress"] = dict(self.progress)
+        if self.result:
+            out["result"] = dict(self.result)
+        if self.error is not None:
+            out["error"] = self.error
+        if self.reason is not None:
+            out["reason"] = self.reason
+        return out
+
+
+def new_job_id() -> str:
+    return "job-" + uuid.uuid4().hex[:12]
+
+
+class ServeJournal:
+    """Append-only request journal (``_serve_journal.jsonl``).
+
+    Single-writer by construction (the daemon is one process; the
+    HTTP threads and the worker serialize on the queue lock before
+    recording), flushed per record so a crash loses at most a torn
+    trailing line — which :func:`recover` tolerates the same way the
+    run journal does.
+    """
+
+    def __init__(self, work_dir: str):
+        self.work_dir = work_dir
+        self.path = os.path.join(work_dir, SERVE_JOURNAL_NAME)
+        self._fh = None
+        self._lock = threading.Lock()
+
+    def record(self, job_id: str, state: str, **fields) -> dict:
+        entry = {"job": job_id, "state": state, "ts": time.time()}
+        entry.update(fields)
+        self._append(entry)
+        return entry
+
+    def record_event(self, event: str, **fields) -> dict:
+        entry = {"event": event, "ts": time.time()}
+        entry.update(fields)
+        self._append(entry)
+        return entry
+
+    def _append(self, entry: dict) -> None:
+        import json
+
+        with self._lock:
+            if self._fh is None:
+                os.makedirs(self.work_dir, exist_ok=True)
+                self._fh = open(self.path, "at")
+            self._fh.write(json.dumps(entry) + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def recover(self) -> list[Job]:
+        """Non-terminal jobs from a previous daemon generation.
+
+        Folds the journal to the latest state per job id (acceptance
+        order preserved) and rebuilds a :class:`Job` for every one
+        that never reached a terminal state.  A job that was RUNNING
+        when the process died comes back ``resumed=True``: its
+        re-execution opens the per-job run journal with resume
+        semantics, so completed micrographs are skipped, not redone.
+        """
+        latest: dict[str, dict] = {}
+        payload: dict[str, dict] = {}
+        cancel_req: set[str] = set()
+        order: list[str] = []
+        for e in _read_entries(self.path):
+            jid = e.get("job")
+            if not jid:
+                continue
+            if jid not in latest:
+                order.append(jid)
+                payload[jid] = e
+            if e.get("cancel_requested"):
+                cancel_req.add(jid)
+            latest[jid] = e
+        out = []
+        for jid in order:
+            state = latest[jid].get("state")
+            if state in TERMINAL_STATES:
+                continue
+            first = payload[jid]
+            job = Job(
+                id=jid,
+                request=first.get("request", {}),
+                accepted_ts=float(first.get("ts", time.time())),
+                deadline_ts=first.get("deadline_ts"),
+                bucket_hint=first.get("bucket_hint"),
+                resumed=state == JOB_RUNNING,
+                # an acknowledged running-job cancel survives the
+                # crash: the re-run stops at its first cancel poll
+                cancel_requested=jid in cancel_req,
+            )
+            out.append(job)
+        return out
+
+
+class CircuitBreaker:
+    """Trip admission open after repeated job FAILURES.
+
+    Failures mean the job itself errored (bad backend, poisoned
+    shared state) — deadline/cancel outcomes are the client's
+    business and never count.  ``threshold`` consecutive failures
+    open the breaker: submissions are refused with 503 until
+    ``cooldown_s`` elapses, after which the breaker goes half-open —
+    admission resumes, and the FIRST job outcome decides: success
+    closes it, failure re-opens it for another cooldown.  This is
+    the standard overload-protection shape (release the retry storm
+    against a broken dependency only gradually).
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 30.0,
+                 clock=time.time):
+        if threshold < 1:
+            raise ValueError("breaker threshold must be >= 1")
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = self.CLOSED
+        self.failures = 0
+        self.opened_ts: float | None = None
+        _BREAKER_STATE.set(0)
+
+    def _set_state(self, state: str) -> None:
+        self.state = state
+        _BREAKER_STATE.set(
+            {self.CLOSED: 0, self.OPEN: 1, self.HALF_OPEN: 2}[state]
+        )
+
+    def check_admission(self) -> None:
+        """Raise :class:`AdmissionError` (503) while open."""
+        with self._lock:
+            if self.state != self.OPEN:
+                return
+            elapsed = self._clock() - (self.opened_ts or 0.0)
+            if elapsed >= self.cooldown_s:
+                self._set_state(self.HALF_OPEN)
+                return
+            raise AdmissionError(
+                503,
+                "circuit_open",
+                self.cooldown_s - elapsed,
+            )
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.failures = 0
+            self._set_state(self.CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.failures += 1
+            if (
+                self.state == self.HALF_OPEN
+                or self.failures >= self.threshold
+            ):
+                if self.state != self.OPEN:
+                    _BREAKER_TRIPS.inc()
+                self._set_state(self.OPEN)
+                self.opened_ts = self._clock()
+
+
+class JobQueue:
+    """Bounded FIFO of accepted jobs with warm-bucket affinity.
+
+    Admission control happens HERE, under one lock, in one place:
+    draining -> 503, breaker open -> 503, queue full (or the
+    ``request_storm`` fault) -> 429 + ``Retry-After``.  Accepted
+    jobs are journaled BEFORE the caller returns 202 — the 202 is a
+    durability promise.
+
+    Scheduling is FIFO with a bounded warm-affinity twist: when the
+    worker's last request warmed a padded capacity bucket, a queued
+    job declaring the same ``bucket_hint`` may jump at most
+    ``affinity_window`` positions, and a job skipped
+    ``max_skips`` times must run next — warm-program reuse without
+    cold-bucket starvation.
+    """
+
+    AFFINITY_WINDOW = 4
+    MAX_SKIPS = 2
+    #: terminal jobs kept addressable in memory (GET /v1/jobs/<id>).
+    #: Older history is still durable — the journal has every state
+    #: transition and jobs/<id>/ keeps the artifacts — so eviction
+    #: only bounds what a long-lived daemon holds live: without it
+    #: _jobs grows one dead Job (request payload, result, progress)
+    #: per request, forever.
+    MAX_TERMINAL = 512
+
+    def __init__(
+        self,
+        limit: int,
+        journal: ServeJournal,
+        breaker: CircuitBreaker | None = None,
+        *,
+        clock=time.time,
+    ):
+        if limit < 1:
+            raise ValueError("queue limit must be >= 1")
+        self.limit = limit
+        self.journal = journal
+        self.breaker = breaker or CircuitBreaker()
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._jobs: dict[str, Job] = {}
+        self._pending: list[str] = []
+        self._terminal: list[str] = []  # completion order (eviction)
+        self._running: str | None = None
+        self.draining = False
+        # decayed average job wall time, the Retry-After estimate
+        self._avg_job_s = 10.0
+
+    # -- admission ----------------------------------------------------
+
+    def submit(
+        self,
+        request: dict,
+        *,
+        deadline_s: float | None = None,
+        bucket_hint: int | None = None,
+    ) -> Job:
+        """Admit one request or raise :class:`AdmissionError`."""
+        if self.draining:
+            _REJECTED.inc(reason="draining")
+            raise AdmissionError(503, "draining", 30.0)
+        try:
+            self.breaker.check_admission()
+        except AdmissionError:
+            _REJECTED.inc(reason="circuit_open")
+            raise
+        with self._lock:
+            backlog = len(self._pending) + (
+                1 if self._running else 0
+            )
+            stormed = faults.check("request_storm", "submit")
+            if backlog >= self.limit or stormed:
+                _REJECTED.inc(reason="queue_full")
+                raise AdmissionError(
+                    429,
+                    "queue_full",
+                    # every queued job ahead costs ~one average job
+                    self._avg_job_s * max(backlog, 1),
+                )
+            now = self._clock()
+            job = Job(
+                id=new_job_id(),
+                request=request,
+                accepted_ts=now,
+                deadline_ts=(
+                    now + deadline_s
+                    if deadline_s is not None
+                    else None
+                ),
+                bucket_hint=bucket_hint,
+            )
+            # journal BEFORE the queue insert becomes visible: once
+            # the caller sees 202 the job survives any crash
+            self.journal.record(
+                job.id,
+                JOB_QUEUED,
+                request=request,
+                deadline_ts=job.deadline_ts,
+                bucket_hint=bucket_hint,
+            )
+            self._jobs[job.id] = job
+            self._pending.append(job.id)
+            _DEPTH.set(len(self._pending))
+        _ADMITTED.inc()
+        crash_point(f"accept:{job.id}")
+        self._wake.set()
+        return job
+
+    def adopt(self, job: Job) -> None:
+        """Re-queue a recovered job (daemon restart) — no admission
+        checks and no re-journaling of the accept: the previous
+        generation already made the durability promise."""
+        with self._lock:
+            self._jobs[job.id] = job
+            self._pending.append(job.id)
+            _DEPTH.set(len(self._pending))
+        self._wake.set()
+
+    # -- worker side --------------------------------------------------
+
+    def next_job(
+        self, timeout: float, last_bucket=None
+    ) -> Job | None:
+        """Pop the next job (warm-affinity FIFO); None on timeout or
+        while draining (queued jobs stay journaled for restart)."""
+        if self.draining:
+            return None
+        self._wake.wait(timeout)
+        with self._lock:
+            self._wake.clear()
+            if self.draining or not self._pending:
+                return None
+            pick = 0
+            head = self._jobs[self._pending[0]]
+            if (
+                last_bucket is not None
+                and head.bucket_hint != last_bucket
+                and head.skipped < self.MAX_SKIPS
+            ):
+                window = self._pending[: self.AFFINITY_WINDOW]
+                for i, jid in enumerate(window):
+                    if self._jobs[jid].bucket_hint == last_bucket:
+                        pick = i
+                        break
+            if pick:
+                head.skipped += 1
+            jid = self._pending.pop(pick)
+            self._running = jid
+            _DEPTH.set(len(self._pending))
+            return self._jobs[jid]
+
+    def finish(self, job: Job, state: str, **fields) -> None:
+        """Record a terminal (or re-queued) state for the job the
+        worker just ran and update the Retry-After estimate."""
+        with self._lock:
+            if self._running == job.id:
+                self._running = None
+            job.state = state
+            job.finished_ts = self._clock()
+            if state in TERMINAL_STATES:
+                if job.started_ts:
+                    dur = max(
+                        job.finished_ts - job.started_ts, 0.0
+                    )
+                    self._avg_job_s = (
+                        0.7 * self._avg_job_s + 0.3 * dur
+                    )
+                self._note_terminal(job.id)
+        self.journal.record(job.id, state, **fields)
+        if state in TERMINAL_STATES:
+            _JOBS.inc(state=state)
+
+    def _note_terminal(self, job_id: str) -> None:
+        """Bound in-memory job history (call with the lock held)."""
+        self._terminal.append(job_id)
+        while len(self._terminal) > self.MAX_TERMINAL:
+            self._jobs.pop(self._terminal.pop(0), None)
+
+    def mark_running(self, job: Job) -> None:
+        job.state = JOB_RUNNING
+        job.started_ts = self._clock()
+        self.journal.record(
+            job.id, JOB_RUNNING, resumed=job.resumed
+        )
+
+    # -- client side --------------------------------------------------
+
+    def get(self, job_id: str) -> Job | None:
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def jobs(self) -> list[Job]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def cancel(self, job_id: str) -> Job | None:
+        """Client cancellation: a queued job is cancelled outright;
+        a running one gets the cooperative flag (next chunk
+        boundary).  Terminal jobs are left untouched."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None or job.state in TERMINAL_STATES:
+                return job
+            if job.state == JOB_QUEUED:
+                self._pending.remove(job_id)
+                _DEPTH.set(len(self._pending))
+                job.state = JOB_CANCELLED
+                job.reason = "cancelled while queued"
+                job.finished_ts = self._clock()
+                self._note_terminal(job_id)
+            else:
+                job.cancel_requested = True
+        # journal outside the lock (the record is its own flush)
+        if job.state == JOB_CANCELLED:
+            self.journal.record(
+                job_id, JOB_CANCELLED,
+                reason="cancelled while queued",
+            )
+            _JOBS.inc(state=JOB_CANCELLED)
+        else:
+            # the acknowledged cancel of a RUNNING job must survive
+            # a crash exactly like the submission's 202 did — a
+            # restarted daemon re-running the job to completion
+            # would silently un-cancel it
+            self.journal.record(
+                job_id, JOB_RUNNING, cancel_requested=True
+            )
+        return job
+
+    def begin_drain(self) -> int:
+        """Stop admission; return the number of queued jobs left
+        journaled for the next generation."""
+        self.draining = True
+        self._wake.set()
+        with self._lock:
+            return len(self._pending)
+
+    def error_doc(self, exc: BaseException) -> dict:
+        return error_info(exc)

@@ -17,7 +17,17 @@ paper (arXiv:1605.08695): the dataflow core never blocks on it.
   :func:`set_status`), plus a cluster liveness view computed on
   request from the coordination directory
   (:func:`repic_tpu.runtime.cluster.read_liveness`).
-* ``/healthz`` — liveness probe (200 ``ok``).
+* ``/healthz`` / ``/healthz/live`` — liveness probe (200 ``ok``):
+  the process is up and serving HTTP.  Never goes false while the
+  server runs — a failing liveness probe means "restart me".
+* ``/healthz/ready`` — readiness probe: 200 only between
+  :func:`set_ready(True)` and ``set_ready(False)``.  Liveness and
+  readiness are DIFFERENT contracts (a draining or still-warming
+  process is alive but must not receive new traffic), so they are
+  different endpoints: the consensus pipeline flips readiness on
+  after its first completed chunk (the warmup analog) and off when
+  the run winds down; the serve daemon flips it after its warmup
+  compile and off for the whole drain.
 
 Off by default; the consensus CLI enables it with ``--status-port``
 (port 0 binds an ephemeral port).  Binds 127.0.0.1 only — exposure
@@ -61,6 +71,18 @@ def get_status() -> dict:
         return dict(_STATUS)
 
 
+def set_ready(flag: bool) -> None:
+    """Flip the active server's readiness probe (no-op when none).
+
+    Same near-zero disabled-mode cost as :func:`set_status`."""
+    if _ACTIVE is not None:
+        _ACTIVE.ready = bool(flag)
+
+
+def is_ready() -> bool:
+    return _ACTIVE is not None and _ACTIVE.ready
+
+
 def active_server() -> "StatusServer | None":
     return _ACTIVE
 
@@ -76,8 +98,21 @@ class StatusServer:
         self.requested_port = int(port)
         self.port: int | None = None
         self.registry = registry
+        self.ready = False
         self._httpd = None
         self._thread: threading.Thread | None = None
+
+    def handle_request(self, handler, method: str, path: str,
+                       body: bytes) -> bool:
+        """Subclass hook: serve one request, return True if handled.
+
+        The serve daemon (:mod:`repic_tpu.serve.daemon`) extends the
+        endpoint surface (``/v1/jobs`` ...) by overriding this —
+        observability plumbing (threading, dispatch, readiness,
+        client-abort tolerance) stays here, defined once.  Use
+        ``handler._send`` / ``handler.send_header`` for responses.
+        """
+        return False
 
     def start(self) -> "StatusServer":
         global _ACTIVE
@@ -87,12 +122,36 @@ class StatusServer:
         server = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 - http.server protocol
+            # a client that connects and never completes a request
+            # must not pin its handler thread forever
+            timeout = 30.0
+
+            def _dispatch(self, method: str):
                 path = self.path.split("?", 1)[0]
-                if path == "/healthz":
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                if server.handle_request(self, method, path, body):
+                    return
+                if method != "GET":
+                    self._send(
+                        405, "text/plain; charset=utf-8",
+                        "method not allowed\n",
+                    )
+                elif path in ("/healthz", "/healthz/live"):
                     self._send(
                         200, "text/plain; charset=utf-8", "ok\n"
                     )
+                elif path == "/healthz/ready":
+                    if server.ready:
+                        self._send(
+                            200, "text/plain; charset=utf-8",
+                            "ready\n",
+                        )
+                    else:
+                        self._send(
+                            503, "text/plain; charset=utf-8",
+                            "unready (warming up or draining)\n",
+                        )
                 elif path == "/metrics":
                     from repic_tpu.telemetry import sinks
 
@@ -118,18 +177,46 @@ class StatusServer:
                         "not found (try /metrics, /status, /healthz)\n",
                     )
 
-            def _send(self, code: int, ctype: str, body: str):
+            def do_GET(self):  # noqa: N802 - http.server protocol
+                self._dispatch("GET")
+
+            def do_POST(self):  # noqa: N802 - http.server protocol
+                self._dispatch("POST")
+
+            def do_DELETE(self):  # noqa: N802 - http.server protocol
+                self._dispatch("DELETE")
+
+            def _send(self, code: int, ctype: str, body: str,
+                      headers: dict | None = None):
                 data = body.encode("utf-8")
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(data)
 
             def log_message(self, *args):  # no per-request stderr spam
                 pass
 
-        self._httpd = http.server.ThreadingHTTPServer(
+        class _QuietServer(http.server.ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                # slow/vanished clients (broken pipe, reset) are the
+                # CLIENT's failure: drop the connection silently
+                # instead of spraying a traceback per disconnect;
+                # anything else keeps the stdlib diagnostics
+                import sys
+
+                exc = sys.exc_info()[1]
+                if isinstance(
+                    exc, (BrokenPipeError, ConnectionResetError,
+                          TimeoutError)
+                ):
+                    return
+                super().handle_error(request, client_address)
+
+        self._httpd = _QuietServer(
             (self.host, self.requested_port), Handler
         )
         self._httpd.daemon_threads = True
@@ -146,6 +233,7 @@ class StatusServer:
 
     def stop(self) -> None:
         global _ACTIVE
+        self.ready = False
         if _ACTIVE is self:
             _ACTIVE = None
             with _STATUS_LOCK:

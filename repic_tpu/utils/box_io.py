@@ -214,20 +214,22 @@ def _read_box_slow(path: str) -> BoxSet:
     )
 
 
-def write_box(
-    path: str,
+def render_box(
     xy: np.ndarray,
     weights: np.ndarray,
     box_size: int,
     *,
     num_particles: int | None = None,
     sort: bool = True,
-) -> None:
-    """Write a consensus BOX file in the reference's output format.
+) -> tuple[str, int]:
+    """Render a consensus BOX file's content (reference output format).
 
-    Crash-safe: content lands in a temp file and is published with
-    one atomic rename, so an interrupted run never leaves a torn BOX
-    file behind (the resume path trusts any file that exists)."""
+    Pure — no filesystem: the serve daemon's emit layer hands the
+    content to a sink of its choosing, and :func:`write_box` pairs it
+    with an atomic write for the CLI path.  Returns ``(content,
+    rows)`` so callers get the post-cutoff row count without
+    re-deriving the ordering.
+    """
     xy = np.asarray(xy)
     weights = np.asarray(weights)
     order = (
@@ -242,21 +244,44 @@ def write_box(
     sizes = np.broadcast_to(
         np.asarray(box_size).reshape(-1), (len(weights),)
     )
-    with atomic_write(path) as o:
-        for i in order:
-            bs = str(int(sizes[i]))
-            o.write(
-                "\t".join(
-                    [
-                        str(int(np.rint(xy[i, 0]))),
-                        str(int(np.rint(xy[i, 1]))),
-                        bs,
-                        bs,
-                        str(weights[i]),
-                    ]
-                )
-                + "\n"
+    lines = []
+    for i in order:
+        bs = str(int(sizes[i]))
+        lines.append(
+            "\t".join(
+                [
+                    str(int(np.rint(xy[i, 0]))),
+                    str(int(np.rint(xy[i, 1]))),
+                    bs,
+                    bs,
+                    str(weights[i]),
+                ]
             )
+            + "\n"
+        )
+    return "".join(lines), len(order)
+
+
+def write_box(
+    path: str,
+    xy: np.ndarray,
+    weights: np.ndarray,
+    box_size: int,
+    *,
+    num_particles: int | None = None,
+    sort: bool = True,
+) -> None:
+    """Write a consensus BOX file in the reference's output format.
+
+    Crash-safe: content lands in a temp file and is published with
+    one atomic rename, so an interrupted run never leaves a torn BOX
+    file behind (the resume path trusts any file that exists)."""
+    content, _ = render_box(
+        xy, weights, box_size,
+        num_particles=num_particles, sort=sort,
+    )
+    with atomic_write(path) as o:
+        o.write(content)
 
 
 def write_empty_box(path: str) -> None:

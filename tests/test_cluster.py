@@ -24,7 +24,7 @@ from repic_tpu.runtime.ladder import (
 )
 
 
-def _ctx(tmp_path, host="hA", rank=0, num_hosts=1, **kw):
+def _ctx(tmp_path, host="hA", rank=0, num_hosts=1, clock=None, **kw):
     kw.setdefault("heartbeat_interval_s", 0.05)
     kw.setdefault("host_timeout_s", 0.5)
     cfg = cluster.ClusterConfig(
@@ -34,7 +34,9 @@ def _ctx(tmp_path, host="hA", rank=0, num_hosts=1, **kw):
         num_hosts=num_hosts,
         **kw,
     )
-    return cluster.ClusterContext(cfg, str(tmp_path))
+    if clock is None:
+        return cluster.ClusterContext(cfg, str(tmp_path))
+    return cluster.ClusterContext(cfg, str(tmp_path), clock=clock)
 
 
 def _age_heartbeat(tmp_path, host, age_s):
@@ -96,11 +98,20 @@ def test_heartbeat_lifecycle(tmp_path):
 def test_heartbeat_thread_renews_and_stops_clean(tmp_path):
     ctx = _ctx(tmp_path)
     ctx.start()
-    time.sleep(0.2)
+    # deterministic renewal: wake the thread explicitly and wait for
+    # the seq to advance instead of sleeping multiples of the
+    # interval and hoping the thread got scheduled (full-suite load
+    # starves daemon threads; see test_harvest_leaves_live_peers_
+    # alone for the clock-injection analog)
+    path = cluster.heartbeat_path(str(tmp_path), "hA")
+    seq0 = json.load(open(path))["seq"]
+    ctx.request_beat()
+    deadline = time.time() + 10.0
+    while json.load(open(path))["seq"] == seq0:
+        assert time.time() < deadline, "renewal thread never beat"
+        time.sleep(0.01)
     ctx.stop()
-    data = json.load(
-        open(cluster.heartbeat_path(str(tmp_path), "hA"))
-    )
+    data = json.load(open(path))
     assert data["stopped"] is True
     assert data["seq"] >= 2  # initial beat + >=1 renewal + stop
 
@@ -333,32 +344,57 @@ def test_harvest_skips_quarantined_and_done(tmp_path):
 
 
 def test_harvest_leaves_live_peers_alone(tmp_path):
-    peer = _ctx(tmp_path, host="hB", rank=1, num_hosts=2)
-    peer.start()  # actively renewing
-    try:
-        peer._lease_names = ["m1"]
-        peer._write_lease()
-        j = _journal(tmp_path, "hA")
-        # generous timeout: the peer renews every 0.05s, but on a
-        # loaded machine (full-suite runs) its daemon thread can
-        # stall past a 0.5s timeout and this test flakes by
-        # "correctly" harvesting a live-but-starved peer
-        ctx = _ctx(
-            tmp_path, host="hA", rank=0, num_hosts=2,
-            host_timeout_s=5.0,
-        )
-        ctx.beat()
-        ctx._lease_names = ["m0"]
-        ctx._write_lease()
-        # hB keeps renewing -> confirmed alive -> harvest returns
-        # empty instead of stealing
-        assert ctx.harvest_orphans(j, ["m0", "m1"]) == []
-        assert not os.path.exists(
-            cluster.fence_path(str(tmp_path), "hB")
-        )
-        j.close()
-    finally:
-        peer.stop()
+    """Deflaked via the injectable clock (PR 7 postmortem): the old
+    version raced the peer's REAL renewal thread against the harvest
+    window, and under full-suite load the starved thread made the
+    harvest "correctly" steal from a live peer.  Now both hosts run
+    on one fake clock, and the survivor's every clock read renews
+    the peer — a deterministic interleaving with no threads and no
+    wall-time dependence."""
+    t = {"now": 1000.0}
+    peer = _ctx(
+        tmp_path, host="hB", rank=1, num_hosts=2,
+        clock=lambda: t["now"],
+    )
+
+    def survivor_clock():
+        # fake time advances far slower than the host timeout, and
+        # the peer provably renews between any two harvest polls
+        t["now"] += 0.01
+        peer.beat()
+        return t["now"]
+
+    peer.beat()
+    peer._lease_names = ["m1"]
+    peer._write_lease()
+    j = _journal(tmp_path, "hA")
+    ctx = _ctx(
+        tmp_path, host="hA", rank=0, num_hosts=2,
+        clock=survivor_clock,
+    )
+    ctx.beat()
+    ctx._lease_names = ["m0"]
+    ctx._write_lease()
+    # hB keeps renewing -> confirmed alive -> harvest returns
+    # empty instead of stealing
+    assert ctx.harvest_orphans(j, ["m0", "m1"]) == []
+    assert not os.path.exists(
+        cluster.fence_path(str(tmp_path), "hB")
+    )
+    j.close()
+
+
+def test_injected_clock_drives_heartbeat_aging(tmp_path):
+    """Liveness rungs follow the injected clock exactly — no
+    backdated files, no sleeps."""
+    t = {"now": 5000.0}
+    ctx = _ctx(tmp_path, clock=lambda: t["now"])
+    ctx.beat()
+    assert ctx.liveness()["hA"].rung == HOST_LIVE
+    t["now"] += ctx.cfg.host_timeout_s + 0.01
+    assert ctx.liveness()["hA"].rung == HOST_SUSPECT
+    ctx.beat()
+    assert ctx.liveness()["hA"].rung == HOST_LIVE
 
 
 @pytest.mark.faults

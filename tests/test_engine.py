@@ -1,0 +1,138 @@
+"""Unit tests for the plan -> execute chunk -> emit engine API.
+
+The pure library split of run_consensus_dir (ROADMAP item 1): no
+filesystem in planning or emission, cancellation only at chunk
+boundaries, and output parity with the directory pipeline's writer.
+"""
+
+import os
+
+import pytest
+
+from repic_tpu.pipeline import engine
+from repic_tpu.utils import box_io
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "mini10017"
+)
+BOX = 180
+
+
+@pytest.fixture(scope="module")
+def loaded():
+    pickers = box_io.discover_picker_dirs(FIXTURE)
+    names = box_io.micrograph_names(
+        os.path.join(FIXTURE, pickers[0])
+    )
+    out = []
+    for n in names:
+        sets = box_io.load_micrograph_set(FIXTURE, pickers, n)
+        assert sets is not None
+        out.append((n, sets))
+    return out
+
+
+def test_options_validation():
+    with pytest.raises(ValueError, match="exact"):
+        engine.ConsensusOptions(solver="exact")
+    with pytest.raises(ValueError, match="unknown option"):
+        engine.ConsensusOptions.from_dict({"typo": 1})
+    with pytest.raises(ValueError, match="JSON object"):
+        engine.ConsensusOptions.from_dict([1])
+    opts = engine.ConsensusOptions.from_dict(
+        {"solver": "lp", "num_particles": 5, "use_mesh": False}
+    )
+    assert opts.solver == "lp" and opts.num_particles == 5
+
+
+def test_plan_request_is_pure_and_bucketed(loaded):
+    opts = engine.ConsensusOptions(use_mesh=False)
+    plan = engine.plan_request(loaded, BOX, opts)
+    # padded capacity lands on the {2^k, 1.5*2^k} bucket grid
+    max_n = max(bs.n for _, sets in loaded for bs in sets)
+    assert plan.capacity >= max_n
+    assert plan.num_pickers == len(loaded[0][1])
+    assert [n for c in plan.chunks for n in c.names] == [
+        n for n, _ in loaded
+    ]
+    # same inputs -> same plan -> same bucket key (the warm handle)
+    again = engine.plan_request(loaded, BOX, opts)
+    assert again.bucket_key == plan.bucket_key
+    with pytest.raises(ValueError):
+        engine.plan_request([], BOX, opts)
+
+
+def test_plan_request_chunks_under_forced_chunk(loaded, monkeypatch):
+    monkeypatch.setenv("REPIC_CONSENSUS_CHUNK", "1")
+    plan = engine.plan_request(
+        loaded, BOX, engine.ConsensusOptions(use_mesh=False)
+    )
+    assert len(plan.chunks) == len(loaded)
+    assert all(c.micrographs == 1 for c in plan.chunks)
+
+
+def test_execute_emit_matches_directory_writer(loaded, tmp_path):
+    """Engine emission == run_consensus_dir's BOX output, byte for
+    byte (same renderer, same packed transfer)."""
+    from repic_tpu.pipeline.consensus import run_consensus_dir
+
+    out_dir = str(tmp_path / "ref")
+    run_consensus_dir(FIXTURE, out_dir, BOX, use_mesh=False)
+    emitted: dict[str, str] = {}
+    for _part, batch, _res, packed, _s in engine.execute_request(
+        loaded, BOX, engine.ConsensusOptions(use_mesh=False)
+    ):
+        engine.emit_box_chunk(
+            batch, packed, BOX,
+            sink=lambda f, c: emitted.__setitem__(f, c),
+        )
+    assert sorted(emitted) == sorted(
+        f for f in os.listdir(out_dir) if f.endswith(".box")
+    )
+    for fname, content in emitted.items():
+        with open(os.path.join(out_dir, fname)) as f:
+            assert f.read() == content, fname
+
+
+def test_cancel_only_at_chunk_boundaries(loaded, monkeypatch):
+    """A cancel firing mid-run stops BETWEEN chunks: everything
+    already yielded is complete, nothing half-done escapes."""
+    monkeypatch.setenv("REPIC_CONSENSUS_CHUNK", "1")
+    polls = []
+
+    def cancel():
+        # allow exactly one chunk, then report an expired deadline
+        polls.append(1)
+        return (
+            "deadline exceeded (test)" if len(polls) > 1 else False
+        )
+
+    done = []
+    with pytest.raises(engine.ConsensusCancelled, match="deadline"):
+        for part, batch, _res, packed, _s in engine.execute_request(
+            loaded, BOX,
+            engine.ConsensusOptions(use_mesh=False),
+            cancel=cancel,
+        ):
+            counts = engine.emit_box_chunk(
+                batch, packed, BOX, sink=lambda f, c: None
+            )
+            done.append((part[0][0], counts))
+    assert len(done) == 1  # one complete chunk, then the boundary
+    assert done[0][1][loaded[0][0]] > 0
+
+
+def test_warmup_compiles_smallest_bucket():
+    info = engine.warmup()
+    assert info["num_pickers"] == 2
+    assert info["capacity"] == 64
+    assert info["compile_s"] >= 0
+
+
+def test_chunk_program_contract_registered():
+    from repic_tpu.analysis.contracts import registry
+
+    assert (
+        "repic_tpu.pipeline.engine.consensus_chunk_program"
+        in registry()
+    )

@@ -1,0 +1,534 @@
+"""Serve daemon tests: lifecycle, admission, deadlines, recovery.
+
+The ISSUE 8 acceptance surface: submit -> poll -> artifacts works;
+overload is an explicit 429 + Retry-After; deadlines cancel at chunk
+boundaries and journal ``deadline_exceeded``; a ``server_crash``
+mid-run loses zero accepted jobs across restart; slow clients hurt
+only themselves; the breaker opens on repeated failures.
+"""
+
+import http.client
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from repic_tpu.runtime import faults
+from repic_tpu.serve.daemon import ConsensusDaemon
+from repic_tpu.serve.jobs import (
+    JOB_FINISHED,
+    SERVE_CRASH_EXIT_CODE,
+    AdmissionError,
+    CircuitBreaker,
+    JobQueue,
+    ServeJournal,
+)
+from repic_tpu.telemetry import server as tlm_server
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "mini10017"
+)
+SUBMIT = {
+    "in_dir": FIXTURE,
+    "box_size": 180,
+    "options": {"use_mesh": False},
+}
+TERMINAL = ("finished", "failed", "cancelled", "deadline_exceeded")
+
+
+def _req(port, method, path, body=None, timeout=30):
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        method=method,
+        data=(
+            json.dumps(body).encode() if body is not None else None
+        ),
+    )
+    try:
+        with urllib.request.urlopen(r, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read().decode()
+
+
+def _wait_terminal(port, job_id, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        code, _, body = _req(port, "GET", f"/v1/jobs/{job_id}")
+        assert code == 200, body
+        doc = json.loads(body)
+        if doc["state"] in TERMINAL:
+            return doc
+        time.sleep(0.05)
+    raise AssertionError(f"job {job_id} never became terminal")
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    d = ConsensusDaemon(
+        str(tmp_path / "wd"),
+        port=0,
+        queue_limit=4,
+        warmup=False,
+        drain_grace_s=10.0,
+    )
+    d.start()
+    yield d
+    if not d.queue.draining:
+        d.drain()
+
+
+# -- unit: journal, breaker, queue ------------------------------------
+
+
+def test_serve_journal_recovery_and_torn_tail(tmp_path):
+    j = ServeJournal(str(tmp_path))
+    j.record("j1", "queued", request={"a": 1})
+    j.record("j2", "queued", request={"a": 2})
+    j.record("j1", "running")
+    j.record("j2", "running")
+    j.record("j2", "finished")
+    j.close()
+    with open(j.path, "a") as f:
+        f.write('{"job": "j3", "state": "que')  # crash mid-append
+    recovered = ServeJournal(str(tmp_path)).recover()
+    assert [r.id for r in recovered] == ["j1"]
+    assert recovered[0].resumed is True  # was running at the crash
+    assert recovered[0].request == {"a": 1}
+
+
+def test_circuit_breaker_state_machine():
+    t = {"now": 0.0}
+    b = CircuitBreaker(
+        threshold=2, cooldown_s=10.0, clock=lambda: t["now"]
+    )
+    b.check_admission()  # closed: fine
+    b.record_failure()
+    b.check_admission()  # one failure: still closed
+    b.record_failure()
+    with pytest.raises(AdmissionError) as exc:
+        b.check_admission()
+    assert exc.value.http_status == 503
+    assert exc.value.retry_after_s >= 1
+    t["now"] += 10.1  # cooldown over -> half-open probe allowed
+    b.check_admission()
+    assert b.state == CircuitBreaker.HALF_OPEN
+    b.record_failure()  # probe failed -> straight back open
+    with pytest.raises(AdmissionError):
+        b.check_admission()
+    t["now"] += 10.1
+    b.check_admission()
+    b.record_success()
+    assert b.state == CircuitBreaker.CLOSED
+    b.check_admission()
+
+
+def test_queue_admission_bounds_and_retry_after(tmp_path):
+    q = JobQueue(2, ServeJournal(str(tmp_path)))
+    q.submit({"r": 1})
+    q.submit({"r": 2})
+    with pytest.raises(AdmissionError) as exc:
+        q.submit({"r": 3})
+    assert exc.value.http_status == 429
+    assert exc.value.reason == "queue_full"
+    assert exc.value.retry_after_s >= 1
+    # draining rejects regardless of depth
+    q2 = JobQueue(2, ServeJournal(str(tmp_path / "d2")))
+    q2.begin_drain()
+    with pytest.raises(AdmissionError) as exc:
+        q2.submit({"r": 1})
+    assert exc.value.http_status == 503
+    assert exc.value.reason == "draining"
+
+
+def test_queue_cancel_and_warm_affinity(tmp_path):
+    q = JobQueue(10, ServeJournal(str(tmp_path)))
+    a = q.submit({"r": 1}, bucket_hint=128)
+    b = q.submit({"r": 2}, bucket_hint=256)
+    c = q.submit({"r": 3}, bucket_hint=256)
+    # warm bucket 256: b and c jump ahead of a (inside the window)
+    assert q.next_job(0.01, last_bucket=256).id == b.id
+    assert q.next_job(0.01, last_bucket=256).id == c.id
+    d = q.submit({"r": 4}, bucket_hint=256)
+    # a was skipped MAX_SKIPS times: fairness forces it next even
+    # though d matches the warm bucket
+    assert q.next_job(0.01, last_bucket=256).id == a.id
+    # cancel a queued job outright
+    assert q.cancel(d.id).state == "cancelled"
+    assert q.next_job(0.01) is None
+
+
+def test_running_cancel_survives_restart(tmp_path):
+    """An acknowledged cancel of a RUNNING job is journaled, so the
+    re-run after a crash stops at its first cancel poll instead of
+    silently un-cancelling."""
+    j = ServeJournal(str(tmp_path))
+    q = JobQueue(4, j)
+    job = q.submit({"r": 1})
+    assert q.next_job(0.01).id == job.id
+    q.mark_running(job)
+    assert q.cancel(job.id).cancel_requested is True
+    j.close()
+    rec = ServeJournal(str(tmp_path)).recover()
+    assert [r.id for r in rec] == [job.id]
+    assert rec[0].resumed is True
+    assert rec[0].cancel_requested is True
+
+
+def test_terminal_jobs_evicted_beyond_cap(tmp_path, monkeypatch):
+    """A long-lived daemon must not hold every dead Job forever:
+    terminal jobs beyond MAX_TERMINAL drop out of the in-memory map
+    (their history stays in the journal and jobs/<id>/)."""
+    monkeypatch.setattr(JobQueue, "MAX_TERMINAL", 3)
+    q = JobQueue(100, ServeJournal(str(tmp_path)))
+    ids = []
+    for i in range(5):
+        job = q.submit({"r": i})
+        ids.append(job.id)
+        assert q.next_job(0.01).id == job.id
+        q.mark_running(job)
+        q.finish(job, JOB_FINISHED)
+    assert q.get(ids[0]) is None
+    assert q.get(ids[1]) is None
+    assert all(q.get(i) is not None for i in ids[2:])
+    assert len(q.jobs()) == 3
+
+
+@pytest.mark.faults
+def test_request_storm_fault_forces_queue_full(tmp_path):
+    q = JobQueue(100, ServeJournal(str(tmp_path)))
+    with faults.fault_plan("request_storm::1"):
+        with pytest.raises(AdmissionError) as exc:
+            q.submit({"r": 1})
+        assert exc.value.http_status == 429
+        assert q.submit({"r": 2}).state == "queued"  # plan spent
+
+
+# -- daemon lifecycle over HTTP ---------------------------------------
+
+
+def test_submit_poll_artifacts_and_warm_second_job(daemon):
+    port = daemon.server.port
+    code, _, _ = _req(port, "GET", "/healthz/live")
+    assert code == 200
+    code, _, body = _req(port, "POST", "/v1/jobs", SUBMIT)
+    assert code == 202, body
+    jid = json.loads(body)["id"]
+    doc = _wait_terminal(port, jid)
+    assert doc["state"] == "finished", doc
+    assert doc["result"]["particles"] > 0
+    assert doc["result"]["journal"] == {"ok": 3}
+    code, _, body = _req(port, "GET", f"/v1/jobs/{jid}/artifacts")
+    arts = json.loads(body)["artifacts"]
+    assert code == 200
+    assert arts == ["mic_000.box", "mic_001.box", "mic_002.box"]
+    code, _, content = _req(
+        port, "GET", f"/v1/jobs/{jid}/artifacts/mic_000.box"
+    )
+    assert code == 200 and len(content.splitlines()) > 0
+    # the parity gate: identical to the file the CLI path writes
+    out = os.path.join(daemon.job_dir(jid), "mic_000.box")
+    with open(out) as f:
+        assert f.read() == content
+    # warm second request on the same capacity bucket: the program
+    # cache hit counter must move (the ISSUE 8 acceptance metric)
+    def _cache(kind):
+        _, _, metrics = _req(port, "GET", "/metrics")
+        for line in metrics.splitlines():
+            if line.startswith(f"repic_program_cache_{kind}"):
+                return float(line.rsplit(" ", 1)[1])
+        return 0.0
+
+    hits0 = _cache("hits_total")
+    code, _, body = _req(port, "POST", "/v1/jobs", SUBMIT)
+    jid2 = json.loads(body)["id"]
+    assert _wait_terminal(port, jid2)["state"] == "finished"
+    assert _cache("hits_total") > hits0
+    # job list shows both
+    _, _, body = _req(port, "GET", "/v1/jobs")
+    assert {j["id"] for j in json.loads(body)["jobs"]} >= {jid, jid2}
+
+
+def test_worker_survives_journal_failure(daemon, monkeypatch):
+    """An exception escaping _run_job (here: the journal's RUNNING
+    record failing, which fires before its try block) must not kill
+    the sole worker thread — a dead worker behind a live HTTP front
+    end would 202 jobs into a queue nothing drains, with every
+    health probe green."""
+    port = daemon.server.port
+    orig = daemon.journal.record
+    armed = {"on": True}
+
+    def flaky(job_id, state, **fields):
+        if state == "running" and armed["on"]:
+            armed["on"] = False
+            raise OSError("disk full")
+        return orig(job_id, state, **fields)
+
+    monkeypatch.setattr(daemon.journal, "record", flaky)
+    code, _, body = _req(port, "POST", "/v1/jobs", SUBMIT)
+    assert code == 202, body
+    doc = _wait_terminal(port, json.loads(body)["id"])
+    assert doc["state"] == "failed", doc
+    assert "disk full" in json.dumps(doc["error"])
+    # the worker survived: the next job runs to completion
+    code, _, body = _req(port, "POST", "/v1/jobs", SUBMIT)
+    assert code == 202, body
+    doc2 = _wait_terminal(port, json.loads(body)["id"])
+    assert doc2["state"] == "finished", doc2
+
+
+def test_submission_validation_maps_to_400(daemon):
+    port = daemon.server.port
+    cases = [
+        {"box_size": 180},                                # no in_dir
+        {"in_dir": "/nonexistent", "box_size": 180},
+        {"in_dir": FIXTURE, "box_size": -1},
+        {"in_dir": FIXTURE, "box_size": 180, "typo": 1},
+        {"in_dir": FIXTURE, "box_size": 180,
+         "options": {"typo": 1}},
+        {"in_dir": FIXTURE, "box_size": 180, "deadline_s": 0},
+    ]
+    for body in cases:
+        code, _, resp = _req(port, "POST", "/v1/jobs", body)
+        assert code == 400, (body, resp)
+    code, _, _ = _req(port, "GET", "/v1/jobs/job-nope")
+    assert code == 404
+
+
+def test_readiness_follows_warmup_and_drain(tmp_path):
+    d = ConsensusDaemon(
+        str(tmp_path / "wd"), port=0, warmup=True
+    )
+    d.start()
+    try:
+        port = d.server.port
+        assert _req(port, "GET", "/healthz/live")[0] == 200
+        deadline = time.time() + 60
+        while _req(port, "GET", "/healthz/ready")[0] != 200:
+            assert time.time() < deadline, "never became ready"
+            time.sleep(0.05)
+        # drain phase 1: readiness red, admission 503, port alive
+        d.begin_drain()
+        assert _req(port, "GET", "/healthz/ready")[0] == 503
+        assert _req(port, "GET", "/healthz/live")[0] == 200
+        code, headers, body = _req(port, "POST", "/v1/jobs", SUBMIT)
+        assert code == 503 and "draining" in body
+        assert int(headers["Retry-After"]) >= 1
+    finally:
+        d.finish_drain()
+    with pytest.raises(urllib.error.URLError):
+        _req(port, "GET", "/healthz/live", timeout=2)
+
+
+def test_deadline_expired_while_queued(daemon):
+    port = daemon.server.port
+    body = dict(SUBMIT, deadline_s=1e-4)
+    code, _, resp = _req(port, "POST", "/v1/jobs", body)
+    assert code == 202
+    doc = _wait_terminal(port, json.loads(resp)["id"])
+    assert doc["state"] == "deadline_exceeded"
+    assert "queued" in doc["reason"]
+
+
+@pytest.mark.faults
+def test_deadline_fault_cancels_at_chunk_boundary(daemon):
+    """The ``deadline_exceeded`` site fires at the worker's chunk-
+    boundary cancel poll — the run stops BETWEEN chunks and the
+    request journal records ``deadline_exceeded``."""
+    port = daemon.server.port
+    with faults.fault_plan("deadline_exceeded::1"):
+        code, _, resp = _req(port, "POST", "/v1/jobs", SUBMIT)
+        assert code == 202
+        jid = json.loads(resp)["id"]
+        doc = _wait_terminal(port, jid)
+    assert doc["state"] == "deadline_exceeded"
+    states = [
+        e.get("state")
+        for e in _read_serve_journal(daemon)
+        if e.get("job") == jid
+    ]
+    assert states == ["queued", "running", "deadline_exceeded"]
+
+
+@pytest.mark.faults
+def test_slow_client_hurts_only_itself(daemon):
+    port = daemon.server.port
+    code, _, resp = _req(port, "POST", "/v1/jobs", SUBMIT)
+    jid = json.loads(resp)["id"]
+    assert _wait_terminal(port, jid)["state"] == "finished"
+    path = f"/v1/jobs/{jid}/artifacts/mic_000.box"
+    with faults.fault_plan("slow_client::1"):
+        with pytest.raises(
+            (http.client.HTTPException, ConnectionError, OSError)
+        ):
+            _req(port, "GET", path)
+    # the daemon shrugged: same artifact, full payload, next request
+    code, _, content = _req(port, "GET", path)
+    assert code == 200 and content
+    assert _req(port, "GET", "/healthz/live")[0] == 200
+    assert json.loads(
+        _req(port, "GET", f"/v1/jobs/{jid}")[2]
+    )["state"] == "finished"
+
+
+def _read_serve_journal(daemon):
+    from repic_tpu.runtime.journal import _read_entries
+
+    return _read_entries(daemon.journal.path)
+
+
+def test_queued_job_survives_restart_in_process(tmp_path):
+    """A daemon that died right after accepting (journal written,
+    worker never started) must run the job on the next start."""
+    wd = str(tmp_path / "wd")
+    dead = ConsensusDaemon(wd, warmup=False)  # never start()ed
+    job = dead.queue.submit(dict(SUBMIT))
+    dead.journal.close()
+    d2 = ConsensusDaemon(wd, warmup=False).start()
+    try:
+        doc = _wait_terminal(d2.server.port, job.id)
+        assert doc["state"] == "finished"
+        arts = os.listdir(d2.job_dir(job.id))
+        assert sum(1 for a in arts if a.endswith(".box")) == 3
+    finally:
+        d2.drain()
+
+
+@pytest.mark.faults
+def test_breaker_opens_after_repeated_failures(tmp_path):
+    """Three poisoned jobs (in_dir vanishes after admission) open
+    the breaker: the next submission is 503 circuit_open."""
+    wd = str(tmp_path / "wd")
+    bad_dir = tmp_path / "bad"
+    bad_dir.mkdir()  # exists at validation, has no picker subdirs
+    d = ConsensusDaemon(
+        wd, port=0, warmup=False, breaker_threshold=3,
+        breaker_cooldown_s=60.0, queue_limit=10,
+    )
+    d.start()
+    try:
+        port = d.server.port
+        bad = {"in_dir": str(bad_dir), "box_size": 180}
+        ids = []
+        for _ in range(3):
+            code, _, resp = _req(port, "POST", "/v1/jobs", bad)
+            assert code == 202
+            ids.append(json.loads(resp)["id"])
+        for jid in ids:
+            assert _wait_terminal(port, jid)["state"] == "failed"
+        code, headers, body = _req(port, "POST", "/v1/jobs", SUBMIT)
+        assert code == 503, body
+        assert "circuit_open" in body
+        assert int(headers["Retry-After"]) >= 1
+    finally:
+        d.drain()
+
+
+# -- crash recovery (subprocess: server_crash is os._exit) ------------
+
+
+def _spawn_daemon(wd, env_extra=None):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        REPIC_TPU_NO_CONFIG_CACHE="1",
+        REPIC_CONSENSUS_CHUNK="1",
+        **(env_extra or {}),
+    )
+    env.pop("REPIC_TPU_FAULTS", None)
+    if env_extra and "REPIC_TPU_FAULTS" in env_extra:
+        env["REPIC_TPU_FAULTS"] = env_extra["REPIC_TPU_FAULTS"]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "repic_tpu.main", "serve", wd,
+         "--port", "0", "--no-warmup"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env=env,
+    )
+    info_path = os.path.join(wd, "_serve.json")
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                "daemon died at startup:\n" + proc.communicate()[0]
+            )
+        try:
+            with open(info_path) as f:
+                info = json.load(f)
+            if info.get("pid") == proc.pid:
+                return proc, info["port"]
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.1)
+    proc.kill()
+    raise AssertionError("daemon never wrote _serve.json")
+
+
+@pytest.mark.faults
+def test_server_crash_recovers_all_accepted_jobs(tmp_path):
+    """The acceptance gate: a daemon crash mid-run (server_crash at
+    a chunk boundary) loses ZERO accepted jobs — the restarted
+    daemon replays the journal, resumes the in-flight job past its
+    completed micrographs, and runs the still-queued one."""
+    wd = str(tmp_path / "wd")
+    os.makedirs(wd)
+    proc, port = _spawn_daemon(
+        wd, {"REPIC_TPU_FAULTS": "server_crash:chunk:1"}
+    )
+    try:
+        code, _, resp = _req(port, "POST", "/v1/jobs", SUBMIT)
+        assert code == 202, resp
+        j1 = json.loads(resp)["id"]
+        code, _, resp = _req(port, "POST", "/v1/jobs", SUBMIT)
+        assert code == 202, resp
+        j2 = json.loads(resp)["id"]
+        # the fault kills the daemon at job 1's first chunk boundary
+        assert proc.wait(timeout=120) == SERVE_CRASH_EXIT_CODE
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    proc2, port2 = _spawn_daemon(wd)
+    try:
+        d1 = _wait_terminal(port2, j1, timeout=180)
+        d2 = _wait_terminal(port2, j2, timeout=180)
+        assert d1["state"] == "finished", d1
+        assert d2["state"] == "finished", d2
+        assert d1["resumed"] is True  # was in flight at the crash
+        for jid in (j1, j2):
+            _, _, body = _req(
+                port2, "GET", f"/v1/jobs/{jid}/artifacts"
+            )
+            assert len(json.loads(body)["artifacts"]) == 3, jid
+        # the resumed job really resumed: generation 2 only
+        # processed what generation 1 had not journaled as done
+        assert d1["result"]["resumed_micrographs"] >= 1, d1
+    finally:
+        proc2.terminate()
+        try:
+            proc2.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc2.kill()
+        proc2.communicate()
+
+
+def test_statusserver_readiness_endpoints_standalone():
+    srv = tlm_server.StatusServer(port=0).start()
+    try:
+        port = srv.port
+        assert _req(port, "GET", "/healthz")[0] == 200
+        assert _req(port, "GET", "/healthz/live")[0] == 200
+        assert _req(port, "GET", "/healthz/ready")[0] == 503
+        tlm_server.set_ready(True)
+        assert _req(port, "GET", "/healthz/ready")[0] == 200
+        tlm_server.set_ready(False)
+        assert _req(port, "GET", "/healthz/ready")[0] == 503
+    finally:
+        srv.stop()
