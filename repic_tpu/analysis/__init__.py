@@ -12,8 +12,16 @@ A second, *semantic* layer rides the same package: accelerator entry
 points declare shape/dtype/sharding/donation contracts with
 ``@repic_tpu.analysis.contracts.checked`` and ``repic-tpu check``
 (:mod:`repic_tpu.analysis.semantic`) verifies them at trace time via
-``jax.eval_shape`` — rules RT101/RT102/RT103/RT105.  The lint layer
-stays JAX-free; only ``check`` (and ``lint --deep``) imports JAX.
+``jax.eval_shape`` — rules RT101/RT102/RT103/RT105.
+
+A third, *whole-program* layer covers the threaded coordination
+code: ``repic-tpu lint --concurrency``
+(:mod:`repic_tpu.analysis.concurrency`) links every module under the
+given paths into one program and checks lock discipline — rules
+RT301–RT305 — with :mod:`repic_tpu.analysis.lockcheck` as the opt-in
+``REPIC_TPU_LOCKCHECK=1`` runtime cross-check.  The lint and
+concurrency layers stay JAX-free; only ``check`` (and ``lint
+--deep``) imports JAX.
 
 Entry points: ``repic-tpu lint``, ``repic-tpu check`` and
 ``python -m repic_tpu.analysis``.  Programmatic use::
@@ -21,9 +29,14 @@ Entry points: ``repic-tpu lint``, ``repic-tpu check`` and
     from repic_tpu.analysis import analyze_source, run_paths
     findings = run_paths(["repic_tpu"])
 
+    from repic_tpu.analysis import run_concurrency
+    findings += run_concurrency(["repic_tpu"])  # RT3xx, still no JAX
+
     from repic_tpu.analysis.semantic import run_check
     report = run_check(["repic_tpu"])   # imports JAX + targets
 """
+
+from repic_tpu.analysis.concurrency import run_concurrency
 
 from repic_tpu.analysis.contracts import (
     ArraySpec,
@@ -50,6 +63,7 @@ __all__ = [
     "checked",
     "format_report",
     "iter_python_files",
+    "run_concurrency",
     "run_paths",
     "spec",
 ]

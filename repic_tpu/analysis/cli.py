@@ -23,9 +23,13 @@ def add_arguments(parser: argparse.ArgumentParser) -> None:
         "in_axes/donate arity) plus the RT201-RT204 project-contract "
         "pack (atomic writes, span balance, journal outcome enum, no "
         "bare print). Exits non-zero on any finding; suppress a line "
-        "with `# repic: noqa[RTxxx]`. With --deep, additionally runs "
-        "the trace-time semantic checker (`repic-tpu check`, rules "
-        "RT1xx) over the same paths."
+        "with `# repic: noqa[RTxxx]`. With --concurrency, "
+        "additionally runs the whole-program RT301-RT305 concurrency "
+        "pass (unguarded shared writes, lock-order cycles, blocking "
+        "under a lock, thread lifecycle, signal-handler safety); "
+        "with --deep, runs the trace-time semantic checker "
+        "(`repic-tpu check`, rules RT1xx) AND the concurrency pass "
+        "over the same paths."
     )
     parser.add_argument(
         "paths",
@@ -41,9 +45,17 @@ def add_arguments(parser: argparse.ArgumentParser) -> None:
     )
     parser.add_argument(
         "--format",
-        choices=["text", "json"],
+        choices=["text", "json", "sarif"],
         default="text",
-        help="report format",
+        help="report format (sarif: SARIF 2.1.0 for GitHub code "
+        "scanning ingestion)",
+    )
+    parser.add_argument(
+        "--concurrency",
+        action="store_true",
+        help="also run the whole-program RT3xx concurrency pass "
+        "(stdlib-only, like lint itself; auto-enabled when --select "
+        "names an RT3xx rule)",
     )
     parser.add_argument(
         "--hints",
@@ -69,11 +81,18 @@ def add_arguments(parser: argparse.ArgumentParser) -> None:
 
 
 def main(args: argparse.Namespace) -> None:
-    from repic_tpu.analysis.engine import format_report, run_paths
+    from repic_tpu.analysis.concurrency import CONCURRENCY_RULES
+    from repic_tpu.analysis.engine import (
+        dedupe_findings,
+        format_report,
+        run_paths,
+    )
     from repic_tpu.analysis.rules import ALL_RULES
 
     if args.list_rules:
         for rule in ALL_RULES:
+            print(f"{rule.rule_id} [{rule.severity}] {rule.title}")
+        for rule in CONCURRENCY_RULES.values():
             print(f"{rule.rule_id} [{rule.severity}] {rule.title}")
         return
     select = None
@@ -82,6 +101,7 @@ def main(args: argparse.Namespace) -> None:
             s.strip().upper() for s in args.select.split(",") if s.strip()
         }
         known = {r.rule_id for r in ALL_RULES}
+        known |= set(CONCURRENCY_RULES)
         if args.deep:
             from repic_tpu.analysis.semantic import SEMANTIC_RULES
 
@@ -89,29 +109,27 @@ def main(args: argparse.Namespace) -> None:
         unknown = select - known
         if unknown:
             sys.exit(f"unknown rule id(s): {', '.join(sorted(unknown))}")
+        if select & set(CONCURRENCY_RULES):
+            args.concurrency = True
     findings = run_paths(args.paths, select=select)
+    if args.concurrency or args.deep:
+        # whole-program RT3xx pass: still pure stdlib ast, but it
+        # parses ALL the paths into one program, so it is a separate
+        # engine from the per-file rules
+        from repic_tpu.analysis.concurrency import run_concurrency
+
+        findings.extend(run_concurrency(args.paths, select=select))
     if args.deep:
         # the semantic pass imports JAX + the targets; lint alone
         # must stay import-free, so this lives behind the flag
         from repic_tpu.analysis.semantic import run_check
 
         report = run_check(args.paths, select=select)
-        # both passes report a missing path as RT000 — dedupe the
-        # merge the same way run_check dedupes internally
-        seen = set()
-        merged = []
-        for f in sorted(
-            findings + report.findings,
-            key=lambda f: (f.path, f.line, f.col, f.rule),
-        ):
-            key = (f.rule, f.path, f.line, f.col, f.message)
-            if key not in seen:
-                seen.add(key)
-                merged.append(f)
-        findings = merged
+        findings.extend(report.findings)
         for s in report.skipped:
             target = s.get("entry") or s.get("path")
             print(f"skip: {target}: {s['reason']}", file=sys.stderr)
+    findings = dedupe_findings(findings)
     code = format_report(
         findings,
         fmt=args.format,

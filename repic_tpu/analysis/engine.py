@@ -611,6 +611,26 @@ def run_paths(paths, select=None) -> list[Finding]:
     return findings
 
 
+def dedupe_findings(findings):
+    """Sort by location and drop exact duplicates.
+
+    Merged passes (per-file lint, the RT3xx whole-program pass, the
+    semantic checker) each report a missing path as their own RT000 —
+    one dedupe, over the union, keeps the report stable no matter
+    which passes ran.
+    """
+    seen = set()
+    out = []
+    for f in sorted(
+        findings, key=lambda f: (f.path, f.line, f.col, f.rule)
+    ):
+        key = (f.rule, f.path, f.line, f.col, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
 def format_report(
     findings,
     fmt: str = "text",
@@ -620,7 +640,12 @@ def format_report(
 ) -> int:
     """Print the report; return the process exit code (0 = clean)."""
     stream = stream or sys.stdout
-    if fmt == "json":
+    if fmt == "sarif":
+        from repic_tpu.analysis.sarif import render_sarif
+
+        json.dump(render_sarif(findings), stream, indent=2)
+        stream.write("\n")
+    elif fmt == "json":
         json.dump([f.to_json() for f in findings], stream, indent=2)
         stream.write("\n")
     else:

@@ -1,0 +1,327 @@
+"""Opt-in runtime lock-order sanitizer (``REPIC_TPU_LOCKCHECK=1``).
+
+The static RT3xx pass (:mod:`repic_tpu.analysis.concurrency`) derives
+the lock graph from source; this module is the dynamic cross-check:
+with ``REPIC_TPU_LOCKCHECK=1`` the tier-1 suite runs with every
+``threading.Lock``/``RLock`` ALLOCATED BY repic_tpu (or test) code
+wrapped in a recording proxy.  Each acquisition appends the lock to a
+thread-local held stack and — when other checked locks are already
+held — adds held->acquired edges to a process-wide order graph.  A
+cycle in that graph is a real, witnessed inconsistent acquisition
+order (the dynamic refinement of static RT302: instances, not
+classes); :func:`note_write` lets tests witness RT301 the same way —
+it records a violation when the named guard lock is not held by the
+writing thread.
+
+Violations are RECORDED, never raised: an exception inside ``acquire``
+on an arbitrary daemon thread would vanish (or deadlock the very code
+under test).  The pytest hook in ``tests/conftest.py`` fails the
+session if :func:`violations` is non-empty at exit — so CI's
+LOCKCHECK job turns any witnessed cycle or unguarded write into a red
+build (docs/static_analysis.md has the runbook).
+
+Design constraints:
+
+* **Scoped wrapping.**  Only allocations whose calling frame belongs
+  to ``repic_tpu`` or the test suite get a checked lock; stdlib/jax
+  internals (``threading.Event``'s inner Condition, executor queues)
+  keep raw locks — zero overhead and zero false edges from code we
+  don't own.
+* **Cheap common case.**  With no other checked lock held, an acquire
+  is one thread-local append; the global graph lock is touched only
+  when a NEW edge appears (bounded by the square of the number of
+  distinct lock sites, in practice a handful).
+* **Reversible.**  :func:`uninstall` restores ``threading.Lock`` /
+  ``threading.RLock``; already-created checked locks keep working
+  (they delegate to real primitives).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import _thread
+
+ENV_VAR = "REPIC_TPU_LOCKCHECK"
+
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+_RAW_ALLOCATE = _thread.allocate_lock
+
+_installed = False
+# raw (never-wrapped) lock guarding the edge graph + violation list
+_graph_lock = _RAW_ALLOCATE()
+_edges: dict[str, set] = {}          # site -> {site}
+_edge_sites: dict[tuple, str] = {}   # (src, dst) -> "thread tb hint"
+_violations: list[dict] = []
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    """True when the environment opts into the sanitizer."""
+    return os.environ.get(ENV_VAR, "") == "1"
+
+
+def _held_stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def _creation_site(depth: int) -> str | None:
+    """``module:line`` of the allocating frame, or None for frames
+    outside repic_tpu / the test suite (those get raw locks)."""
+    try:
+        frame = sys._getframe(depth)
+    except ValueError:  # pragma: no cover - shallow stack
+        return None
+    mod = frame.f_globals.get("__name__", "")
+    if not (
+        mod.startswith("repic_tpu")
+        or mod.startswith("tests")
+        or mod.startswith("test_")
+        or mod == "conftest"
+    ):
+        return None
+    return f"{mod}:{frame.f_lineno}"
+
+
+class CheckedLock:
+    """Recording proxy around a real Lock/RLock."""
+
+    __slots__ = ("_lock", "site", "kind")
+
+    def __init__(self, site: str, kind: str = "lock"):
+        self._lock = (
+            _ORIG_RLOCK() if kind == "rlock" else _RAW_ALLOCATE()
+        )
+        self.site = site
+        self.kind = kind
+
+    # -- recording ----------------------------------------------------
+
+    def _record_acquire(self) -> None:
+        stack = _held_stack()
+        for held in stack:
+            if held is self or held.site == self.site:
+                continue
+            _note_edge(held.site, self.site)
+        stack.append(self)
+
+    def _record_release(self) -> None:
+        stack = _held_stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                return
+
+    def held_by_current_thread(self) -> bool:
+        return any(h is self for h in _held_stack())
+
+    # -- lock protocol ------------------------------------------------
+
+    def acquire(self, blocking=True, timeout=-1):
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._record_acquire()
+        return got
+
+    def release(self):
+        self._record_release()
+        self._lock.release()
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"<CheckedLock {self.kind} {self.site}>"
+
+
+def _note_edge(src: str, dst: str) -> None:
+    with _graph_lock:
+        dsts = _edges.setdefault(src, set())
+        if dst in dsts:
+            return
+        dsts.add(dst)
+        _edges.setdefault(dst, set())
+        _edge_sites[(src, dst)] = threading.current_thread().name
+        cycle = _find_cycle(dst, src)
+        if cycle is not None:
+            _violations.append(
+                {
+                    "kind": "lock-order-cycle",
+                    "cycle": [src] + cycle,
+                    "detail": (
+                        "acquired "
+                        + " -> ".join([src, dst])
+                        + " while the reverse path "
+                        + " -> ".join(cycle)
+                        + " was already witnessed"
+                    ),
+                }
+            )
+
+
+def _find_cycle(start: str, goal: str) -> list | None:
+    """Path start -> ... -> goal in the edge graph (DFS), or None.
+
+    Called with the graph lock held; the graph is tiny (one node per
+    static lock allocation site)."""
+    stack = [(start, [start])]
+    seen = set()
+    while stack:
+        node, path = stack.pop()
+        if node == goal:
+            return path
+        if node in seen:
+            continue
+        seen.add(node)
+        for nxt in sorted(_edges.get(node, ())):
+            stack.append((nxt, path + [nxt]))
+    return None
+
+
+def note_write(what: str, lock) -> bool:
+    """Witness hook for RT301: record a violation unless ``lock`` is
+    held by the calling thread.  Returns True when properly guarded.
+    No-op (True) for raw locks and when the sanitizer is inactive."""
+    if not isinstance(lock, CheckedLock):
+        return True
+    if lock.held_by_current_thread():
+        return True
+    with _graph_lock:
+        _violations.append(
+            {
+                "kind": "unguarded-write",
+                "what": what,
+                "lock": lock.site,
+                "thread": threading.current_thread().name,
+                "detail": (
+                    f"write to {what} without holding the checked "
+                    f"lock created at {lock.site}"
+                ),
+            }
+        )
+    return False
+
+
+# -- factories + install/uninstall ------------------------------------
+
+
+def checked_lock(site: str | None = None, kind: str = "lock"):
+    """Explicitly create a checked lock (unit tests; no install)."""
+    return CheckedLock(site or _creation_site(2) or "<direct>", kind)
+
+
+def _lock_factory():
+    site = _creation_site(2)
+    if site is None:
+        return _RAW_ALLOCATE()
+    return CheckedLock(site, "lock")
+
+
+def _rlock_factory():
+    site = _creation_site(2)
+    if site is None:
+        return _ORIG_RLOCK()
+    return CheckedLock(site, "rlock")
+
+
+def install() -> bool:
+    """Patch ``threading.Lock``/``RLock`` with the scoped factories.
+
+    Idempotent; returns True when the sanitizer is (now) active."""
+    global _installed
+    if _installed:
+        return True
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    _installed = True
+    return True
+
+
+def uninstall() -> None:
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _ORIG_LOCK
+    threading.RLock = _ORIG_RLOCK
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def maybe_install_from_env() -> bool:
+    """Install iff ``REPIC_TPU_LOCKCHECK=1`` (the conftest hook)."""
+    if enabled():
+        return install()
+    return False
+
+
+# -- reporting --------------------------------------------------------
+
+
+def edges() -> dict:
+    """Snapshot of the witnessed acquisition-order graph."""
+    with _graph_lock:
+        return {k: set(v) for k, v in _edges.items()}
+
+
+def violations() -> list[dict]:
+    with _graph_lock:
+        return list(_violations)
+
+
+def reset() -> None:
+    """Clear the graph and violations (test isolation)."""
+    with _graph_lock:
+        _edges.clear()
+        _edge_sites.clear()
+        _violations.clear()
+
+
+@contextlib.contextmanager
+def scoped():
+    """Isolate graph/violation mutations (unit tests).
+
+    The sanitizer's own tests deliberately witness cycles and
+    unguarded writes; without isolation those recordings would leak
+    into the process-wide state and trip the session-level gate in
+    ``tests/conftest.py``.  Snapshots on entry, restores on exit —
+    violations recorded by OTHER code before the scope survive."""
+    with _graph_lock:
+        edges_snap = {k: set(v) for k, v in _edges.items()}
+        sites_snap = dict(_edge_sites)
+        violations_snap = list(_violations)
+    try:
+        yield
+    finally:
+        with _graph_lock:
+            _edges.clear()
+            _edges.update(edges_snap)
+            _edge_sites.clear()
+            _edge_sites.update(sites_snap)
+            _violations[:] = violations_snap
+
+
+def report_text() -> str:
+    """Human-readable violation report (printed by the pytest hook)."""
+    out = []
+    for v in violations():
+        out.append(f"LOCKCHECK {v['kind']}: {v['detail']}")
+    if not out:
+        return "LOCKCHECK: no violations"
+    return "\n".join(out)

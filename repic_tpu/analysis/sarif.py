@@ -1,0 +1,124 @@
+"""SARIF 2.1.0 rendering for lint/check/concurrency findings.
+
+GitHub code scanning (and most SARIF viewers) can ingest the output
+of ``repic-tpu lint --format sarif``: one run, one driver
+(``repic-tpu-lint``), a rule table assembled from every pack that can
+contribute findings (RT0xx/RT2xx per-file lint, RT1xx semantic check
+via ``--deep``, RT3xx concurrency via ``--concurrency``), and one
+result per finding with a physical location.  Pure stdlib — the
+renderer must work in the dependency-free CI lint job.
+
+The field contract (pinned by tests/test_lint_smoke.py):
+
+* ``version`` == "2.1.0" and the matching ``$schema``
+* ``runs[0].tool.driver.name`` == "repic-tpu-lint", with ``rules``
+  entries carrying ``id``, ``shortDescription.text``, ``help.text``
+  and ``defaultConfiguration.level``
+* ``runs[0].results[*]``: ``ruleId``, ``ruleIndex``, ``level``
+  (``error``/``warning``), ``message.text``, and
+  ``locations[0].physicalLocation`` with ``artifactLocation.uri``
+  plus a 1-based ``region.startLine``/``startColumn``
+"""
+
+from __future__ import annotations
+
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def _known_rules() -> dict:
+    """id -> (severity, title, hint) for every rule pack that can
+    contribute findings to a lint report."""
+    from repic_tpu.analysis.concurrency import CONCURRENCY_RULES
+    from repic_tpu.analysis.rules import ALL_RULES
+    from repic_tpu.analysis.semantic import SEMANTIC_RULES
+
+    out = {
+        "RT000": (
+            "error",
+            "analysis error (unreadable path / syntax error)",
+            "",
+        )
+    }
+    for rule in ALL_RULES:
+        out[rule.rule_id] = (rule.severity, rule.title, rule.hint)
+    for rule in CONCURRENCY_RULES.values():
+        out[rule.rule_id] = (rule.severity, rule.title, rule.hint)
+    for rule_id, (severity, hint) in SEMANTIC_RULES.items():
+        out[rule_id] = (severity, f"trace-time contract {rule_id}",
+                        hint)
+    return out
+
+
+def render_sarif(findings) -> dict:
+    """SARIF 2.1.0 document for a list of engine ``Finding``s."""
+    from repic_tpu import __version__
+
+    known = _known_rules()
+    rule_ids = sorted(
+        {f.rule for f in findings} | set(known)
+    )
+    rules = []
+    index = {}
+    for i, rule_id in enumerate(rule_ids):
+        severity, title, hint = known.get(
+            rule_id, ("warning", rule_id, "")
+        )
+        index[rule_id] = i
+        rules.append(
+            {
+                "id": rule_id,
+                "shortDescription": {"text": title or rule_id},
+                "help": {"text": hint or title or rule_id},
+                "defaultConfiguration": {"level": severity},
+            }
+        )
+    results = []
+    for f in findings:
+        results.append(
+            {
+                "ruleId": f.rule,
+                "ruleIndex": index[f.rule],
+                "level": (
+                    f.severity
+                    if f.severity in ("error", "warning", "note")
+                    else "warning"
+                ),
+                "message": {"text": f.message},
+                "locations": [
+                    {
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": f.path.replace("\\", "/"),
+                            },
+                            "region": {
+                                "startLine": max(int(f.line), 1),
+                                "startColumn": int(f.col) + 1,
+                            },
+                        }
+                    }
+                ],
+            }
+        )
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "repic-tpu-lint",
+                        "informationUri": (
+                            "https://github.com/repic-tpu/repic-tpu"
+                            "/blob/main/docs/static_analysis.md"
+                        ),
+                        "version": __version__,
+                        "rules": rules,
+                    }
+                },
+                "results": results,
+            }
+        ],
+    }

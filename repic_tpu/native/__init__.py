@@ -29,6 +29,11 @@ import numpy as np
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _LOCK = threading.Lock()
 _LIBS: dict = {}  # stem -> CDLL | None (None = load failed)
+# Per-stem build serialization: compiling one stem (up to 120 s of
+# g++) must not stall loads of OTHER stems, and the module lock must
+# never be held across the compile (RT301/RT303 — _LOCK only guards
+# the two cache dicts).
+_STEM_LOCKS: dict = {}  # stem -> Lock
 
 
 def _build(stem: str, force: bool = False) -> str | None:
@@ -70,6 +75,13 @@ def _load(stem: str, configure) -> ctypes.CDLL | None:
     with _LOCK:
         if stem in _LIBS:
             return _LIBS[stem]
+        stem_lock = _STEM_LOCKS.setdefault(stem, threading.Lock())
+    with stem_lock:
+        with _LOCK:
+            # another thread may have finished the build while we
+            # waited on the stem lock
+            if stem in _LIBS:
+                return _LIBS[stem]
         lib = None
         for attempt in range(2):
             # Second attempt force-rebuilds: a stale or foreign-arch
@@ -88,7 +100,8 @@ def _load(stem: str, configure) -> ctypes.CDLL | None:
                 # expected symbol — force-rebuild on attempt 2, cache
                 # the failure otherwise
                 continue
-        _LIBS[stem] = lib
+        with _LOCK:
+            _LIBS[stem] = lib
     return lib
 
 

@@ -181,7 +181,10 @@ class ServeJournal:
                 os.makedirs(self.work_dir, exist_ok=True)
                 self._fh = open(self.path, "at")
             self._fh.write(json.dumps(entry) + "\n")
-            self._fh.flush()
+            # flush-before-202 IS the durability promise, and
+            # serializing exactly this append+flush is this lock's
+            # purpose — the documented intentionally-safe RT303 case
+            self._fh.flush()  # repic: noqa[RT303]
 
     def close(self) -> None:
         with self._lock:
@@ -484,8 +487,12 @@ class JobQueue:
             self._jobs.pop(self._terminal.pop(0), None)
 
     def mark_running(self, job: Job) -> None:
-        job.state = JOB_RUNNING
-        job.started_ts = self._clock()
+        # job.state is lock-guarded shared state (finish/cancel and
+        # the HTTP doc() readers): RT301 — mutate under the lock,
+        # journal outside it (the record is its own flush)
+        with self._lock:
+            job.state = JOB_RUNNING
+            job.started_ts = self._clock()
         self.journal.record(
             job.id, JOB_RUNNING, resumed=job.resumed
         )
@@ -508,7 +515,20 @@ class JobQueue:
             job = self._jobs.get(job_id)
             if job is None or job.state in TERMINAL_STATES:
                 return job
-            if job.state == JOB_QUEUED:
+            # membership check, not just state: between next_job's
+            # pop and mark_running's state write the job reads as
+            # QUEUED but is no longer in the queue — cancelling it
+            # outright would ValueError on the remove and lose the
+            # worker's copy; treat it as running (cooperative flag).
+            # The branch is decided by THIS local, never by a
+            # post-lock re-read of job.state: a concurrent finish()
+            # could flip the state between the release and the
+            # journal write, double-recording the cancel or
+            # resurrecting a finished job on recover.
+            outright = (
+                job.state == JOB_QUEUED and job_id in self._pending
+            )
+            if outright:
                 self._pending.remove(job_id)
                 _DEPTH.set(len(self._pending))
                 job.state = JOB_CANCELLED
@@ -517,21 +537,28 @@ class JobQueue:
                 self._note_terminal(job_id)
             else:
                 job.cancel_requested = True
-        # journal outside the lock (the record is its own flush)
-        if job.state == JOB_CANCELLED:
+                # the acknowledged cancel of a RUNNING job must
+                # survive a crash exactly like the submission's 202
+                # did — a restarted daemon re-running the job to
+                # completion would silently un-cancel it.  Recorded
+                # UNDER the queue lock: finish() marks the job
+                # terminal under this same lock before journaling,
+                # so its terminal record always lands AFTER this
+                # running-state record — journaled the other way
+                # around, recover() would fold the finished job back
+                # to running and resurrect it.
+                self.journal.record(
+                    job_id, JOB_RUNNING, cancel_requested=True
+                )
+        if outright:
+            # terminal under the lock above, so no concurrent
+            # finish()/cancel() can interleave; the record itself is
+            # its own flush and needs no lock
             self.journal.record(
                 job_id, JOB_CANCELLED,
                 reason="cancelled while queued",
             )
             _JOBS.inc(state=JOB_CANCELLED)
-        else:
-            # the acknowledged cancel of a RUNNING job must survive
-            # a crash exactly like the submission's 202 did — a
-            # restarted daemon re-running the job to completion
-            # would silently un-cancel it
-            self.journal.record(
-                job_id, JOB_RUNNING, cancel_requested=True
-            )
         return job
 
     def begin_drain(self) -> int:

@@ -27,6 +27,17 @@ if (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Opt-in runtime lock-order sanitizer (REPIC_TPU_LOCKCHECK=1): wrap
+# every repic_tpu/test-allocated threading.Lock/RLock in a recording
+# proxy BEFORE any test module imports repic_tpu (module-level locks
+# like native._LOCK are allocated at import time).  The session is
+# failed at exit on any witnessed lock-order cycle or unguarded-write
+# — the dynamic cross-check of the static RT3xx pass
+# (docs/static_analysis.md "LOCKCHECK runbook").
+from repic_tpu.analysis import lockcheck as _lockcheck
+
+_lockcheck.maybe_install_from_env()
+
 # The sandbox's sitecustomize may import jax (registering a TPU
 # plugin) before this conftest runs, in which case the env var alone
 # is too late — force the platform via the config API as well.
@@ -69,3 +80,19 @@ needs_reference = pytest.mark.skipif(
     not reference_available(),
     reason="example data not found (neither in-repo nor mounted)",
 )
+
+
+def pytest_terminal_summary(terminalreporter):
+    if not _lockcheck.installed():
+        return
+    report = _lockcheck.report_text()
+    terminalreporter.section("LOCKCHECK (REPIC_TPU_LOCKCHECK=1)")
+    terminalreporter.write_line(report)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # A witnessed violation is a red build even if every test passed:
+    # the sanitizer records (never raises) so the failure must be
+    # promoted here, at session scope.
+    if _lockcheck.installed() and _lockcheck.violations():
+        session.exitstatus = 1

@@ -87,6 +87,103 @@ def test_json_format_carries_machine_readable_fields(tmp_path):
     }
 
 
+def test_sarif_format_on_clean_tree(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    proc = _run(
+        ["-m", "repic_tpu.analysis", str(clean), "--format", "sarif"]
+    )
+    assert proc.returncode == 0, proc.stdout
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["results"] == []
+
+
+def test_sarif_format_carries_code_scanning_fields(tmp_path):
+    # GitHub code scanning ingests this shape (docs/static_analysis.md
+    # "SARIF"): pinned here so renderer drift fails a tier-1 test,
+    # not an upload half an hour into CI
+    dirty = tmp_path / "repic_tpu"
+    dirty.mkdir()
+    bad = dirty / "dirty.py"
+    bad.write_text(
+        "import jax\n"
+        "\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n"
+    )
+    proc = _run(
+        ["-m", "repic_tpu.analysis", str(bad), "--format", "sarif"]
+    )
+    assert proc.returncode == 1, proc.stdout
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-schema-2.1.0.json")
+    run = doc["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "repic-tpu-lint"
+    assert driver["version"]
+    rules = driver["rules"]
+    by_id = {r["id"]: r for r in rules}
+    # the rule table covers every pack that can contribute findings
+    for rule_id in ("RT002", "RT101", "RT201", "RT301", "RT305"):
+        r = by_id[rule_id]
+        assert r["shortDescription"]["text"]
+        assert r["help"]["text"]
+        assert r["defaultConfiguration"]["level"] in (
+            "error", "warning", "note",
+        )
+    results = run["results"]
+    assert results, "expected an RT002 result"
+    res = results[0]
+    assert res["ruleId"] == "RT002"
+    assert rules[res["ruleIndex"]]["id"] == "RT002"
+    assert res["level"] == "error"
+    assert res["message"]["text"]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"].endswith("dirty.py")
+    assert loc["region"]["startLine"] == 5
+    assert loc["region"]["startColumn"] >= 1
+
+
+def test_lint_help_documents_concurrency_and_sarif():
+    proc = _run(["-m", "repic_tpu.main", "lint", "--help"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "--concurrency" in proc.stdout
+    assert "sarif" in proc.stdout
+
+
+def test_list_rules_covers_the_concurrency_pack():
+    proc = _run(["-m", "repic_tpu.analysis", "--list-rules"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    for rule_id in ("RT301", "RT302", "RT303", "RT304", "RT305"):
+        assert rule_id in proc.stdout, rule_id
+
+
+def test_selecting_an_rt3xx_rule_enables_the_pass(tmp_path):
+    # --select RT303 without --concurrency must still run the
+    # whole-program pass (a select that silently no-ops reads green)
+    bad = tmp_path / "mod.py"
+    bad.write_text(
+        "import threading\n"
+        "import time\n"
+        "LOCK = threading.Lock()\n"
+        "\n"
+        "\n"
+        "def f():\n"
+        "    with LOCK:\n"
+        "        time.sleep(1.0)\n"
+    )
+    proc = _run(
+        ["-m", "repic_tpu.analysis", str(bad), "--select", "RT303"]
+    )
+    assert proc.returncode == 1, proc.stdout
+    assert "RT303" in proc.stdout
+
+
 def test_check_help_exits_zero():
     proc = _run(["-m", "repic_tpu.main", "check", "--help"])
     assert proc.returncode == 0, proc.stderr[-2000:]

@@ -128,3 +128,26 @@ def test_native_deep_chain_no_stack_overflow():
     assert got is not None
     # alternating selection is optimal for a unit-weight chain
     assert got.sum() == (n + 1) // 2
+
+
+def test_load_builds_outside_module_lock(monkeypatch):
+    """RT303 sweep regression: the (up to 120 s) g++ compile must not
+    run while holding the module cache lock — a concurrent load of a
+    DIFFERENT stem must only contend for the tiny dict sections."""
+    seen = {}
+
+    def fake_build(stem, force=False):
+        seen["locked_during_build"] = native._LOCK.locked()
+        return None
+
+    monkeypatch.setattr(native, "_build", fake_build)
+    monkeypatch.setattr(native, "_LIBS", {})
+    monkeypatch.setattr(native, "_STEM_LOCKS", {})
+    assert native._load("stem_x", lambda lib: None) is None
+    assert seen["locked_during_build"] is False
+    # the failure is cached: a second load never re-builds
+    seen.clear()
+    assert native._load("stem_x", lambda lib: None) is None
+    assert not seen
+    # and each stem serializes on its own lock
+    assert set(native._STEM_LOCKS) == {"stem_x"}

@@ -163,6 +163,24 @@ def test_queue_cancel_and_warm_affinity(tmp_path):
     assert q.next_job(0.01) is None
 
 
+def test_cancel_of_popped_but_unmarked_job_is_cooperative(tmp_path):
+    """RT301 sweep regression: between next_job's pop and
+    mark_running's state write the job still reads QUEUED but is no
+    longer in the queue — cancel must set the cooperative flag (and
+    not ValueError on the pending remove / lose the worker's copy)."""
+    q = JobQueue(10, ServeJournal(str(tmp_path)))
+    job = q.submit({"r": 1})
+    popped = q.next_job(0.01)
+    assert popped is job  # the worker holds it; not yet mark_running
+    got = q.cancel(job.id)  # must not raise
+    assert got is job
+    assert job.cancel_requested is True
+    assert job.state == "queued"  # state write is mark_running's
+    q.mark_running(job)
+    assert job.state == "running"
+    assert job.cancel_requested is True  # the cancel was not lost
+
+
 def test_running_cancel_survives_restart(tmp_path):
     """An acknowledged cancel of a RUNNING job is journaled, so the
     re-run after a crash stops at its first cancel poll instead of
@@ -178,6 +196,57 @@ def test_running_cancel_survives_restart(tmp_path):
     assert [r.id for r in rec] == [job.id]
     assert rec[0].resumed is True
     assert rec[0].cancel_requested is True
+
+
+def test_concurrent_cancel_and_finish_never_resurrect(tmp_path):
+    """Journal-ordering regression (PR 9 review): cancel() must
+    decide its branch and journal its running-state record under the
+    queue lock — deciding from a post-lock re-read of job.state let a
+    concurrent finish() interleave, either double-journaling the
+    cancel or appending a stale RUNNING record AFTER the terminal one
+    (recover() folds to latest state, resurrecting a finished job on
+    restart)."""
+    import threading
+
+    for _ in range(30):
+        wd = str(tmp_path / f"r{_}")
+        j = ServeJournal(wd)
+        q = JobQueue(4, j)
+        job = q.submit({"r": 1})
+        assert q.next_job(0.01).id == job.id
+        q.mark_running(job)
+        go = threading.Barrier(2)
+
+        def do_cancel():
+            go.wait(5)
+            q.cancel(job.id)
+
+        def do_finish():
+            go.wait(5)
+            q.finish(job, JOB_FINISHED)
+
+        ts = [
+            threading.Thread(target=do_cancel),
+            threading.Thread(target=do_finish),
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(10)
+        j.close()
+        entries = [
+            json.loads(line)
+            for line in open(os.path.join(wd, "_serve_journal.jsonl"))
+            if '"job"' in line
+        ]
+        states = [e["state"] for e in entries if e["job"] == job.id]
+        # whatever the interleaving: the terminal record is LAST,
+        # exactly one of it, and never a doubled cancelled record
+        assert states[-1] == JOB_FINISHED, states
+        assert states.count(JOB_FINISHED) == 1, states
+        assert states.count("cancelled") == 0, states
+        # so a restarted daemon recovers nothing
+        assert ServeJournal(wd).recover() == []
 
 
 def test_terminal_jobs_evicted_beyond_cap(tmp_path, monkeypatch):
