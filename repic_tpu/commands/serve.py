@@ -79,13 +79,30 @@ def add_arguments(parser):
         help="skip the startup warmup compile; readiness goes green "
         "immediately and the first request pays the first compile",
     )
+    parser.add_argument(
+        "--slo-target",
+        action="append",
+        default=None,
+        metavar="EP=S[@GOAL]",
+        help="latency objective, repeatable: endpoint=seconds with "
+        "an optional @goal fraction (default 0.95). Endpoints: "
+        "'job' (accept->terminal), 'queue_wait', 'http:<route>'. "
+        "Example: --slo-target job=60@0.95 --slo-target "
+        "queue_wait=10. /status then reports compliance and "
+        "error-budget burn per endpoint (docs/serving.md)",
+    )
 
 
 def main(args):
     import sys
 
     from repic_tpu.serve.daemon import ConsensusDaemon
+    from repic_tpu.telemetry.server import parse_slo_targets
 
+    try:
+        slo_targets = parse_slo_targets(args.slo_target)
+    except ValueError as e:
+        raise SystemExit(f"repic-tpu serve: {e}") from e
     daemon = ConsensusDaemon(
         args.work_dir,
         port=args.port,
@@ -95,6 +112,7 @@ def main(args):
         breaker_threshold=args.breaker_threshold,
         breaker_cooldown_s=args.breaker_cooldown,
         warmup=not args.no_warmup,
+        slo_targets=slo_targets,
     )
     try:
         daemon.start()

@@ -1,0 +1,131 @@
+"""``trace`` subcommand — per-request waterfall + critical path.
+
+Renders the request-scoped trace artifact (``_trace.jsonl``,
+docs/observability.md "Traces") a serve job or CLI consensus run
+leaves next to its journal: one waterfall per trace with the
+queue_wait / plan / compile / execute / emit segments, the RT105
+program-cache hit/miss join on the compile segment, the critical
+path, and — when the run was device-timed — the device-tail total
+from the PR 7 dispatch spans (joined by trace id from the event
+stream).
+
+Usage::
+
+    repic-tpu trace <run_dir>             # a consensus output dir
+    repic-tpu trace <work_dir> <job_id>   # one serve job
+    repic-tpu trace <work_dir>            # lists jobs with traces
+
+Host-only: reads JSONL artifacts, never imports jax, so it runs in
+seconds on a login node — including against the torn artifact a
+crashed job leaves behind (the reader tolerates a torn trailing
+line, so the partial waterfall still renders).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+name = "trace"
+
+
+def add_arguments(parser) -> None:
+    parser.add_argument(
+        "run_dir",
+        help="a run directory holding _trace.jsonl (a consensus "
+        "output dir or a serve jobs/<id>/ dir), or a serve work_dir "
+        "when a job id is given",
+    )
+    parser.add_argument(
+        "job_id",
+        nargs="?",
+        default=None,
+        help="serve job id: renders <run_dir>/jobs/<job_id>; "
+        "omitted, <run_dir> itself must hold the trace artifact",
+    )
+    parser.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the machine-readable per-trace summary instead "
+        "of the waterfall",
+    )
+
+
+def _resolve_dir(run_dir: str, job_id: str | None) -> str:
+    if job_id is None:
+        return run_dir
+    for cand in (
+        os.path.join(run_dir, "jobs", job_id),
+        os.path.join(run_dir, job_id),
+    ):
+        if os.path.isdir(cand):
+            return cand
+    raise SystemExit(
+        f"repic-tpu trace: no job directory for {job_id!r} under "
+        f"{run_dir}"
+    )
+
+
+def _list_jobs(run_dir: str) -> list[str]:
+    """Serve-work-dir fallback: job ids that carry a trace artifact."""
+    from repic_tpu.telemetry.trace import TRACE_NAME
+
+    jobs_dir = os.path.join(run_dir, "jobs")
+    if not os.path.isdir(jobs_dir):
+        return []
+    return sorted(
+        j
+        for j in os.listdir(jobs_dir)
+        if os.path.exists(os.path.join(jobs_dir, j, TRACE_NAME))
+    )
+
+
+def main(args) -> None:
+    from repic_tpu.telemetry import events as tlm_events
+    from repic_tpu.telemetry import trace as tlm_trace
+
+    run_dir = _resolve_dir(args.run_dir, args.job_id)
+    records = tlm_trace.read_trace(run_dir)
+    if not records:
+        jobs = _list_jobs(run_dir)
+        if jobs:
+            print(f"jobs with traces under {run_dir}:")
+            for j in jobs:
+                print(f"  {j}")
+            print("render one with: repic-tpu trace "
+                  f"{args.run_dir} <job_id>")
+            return
+        raise SystemExit(
+            "repic-tpu trace: no trace artifact "
+            f"({tlm_trace.TRACE_NAME}) in {run_dir}"
+        )
+    summaries = tlm_trace.summarize(records)
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "run_dir": os.path.abspath(run_dir),
+                    "traces": summaries,
+                },
+                indent=2,
+                sort_keys=True,
+            )
+        )
+        return
+    # device-time join: dispatch spans in the same directory's event
+    # stream carry the trace id (and, under --device-time, the
+    # host/device split)
+    events = tlm_events.read_events(run_dir)
+    first = True
+    for tid, tr in summaries.items():
+        if not first:
+            print()
+        first = False
+        print(tlm_trace.render_waterfall(tid, tr, events=events))
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    add_arguments(parser)
+    main(parser.parse_args())

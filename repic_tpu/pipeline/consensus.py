@@ -57,7 +57,9 @@ from repic_tpu.runtime.ladder import (
     solve_host_ladder,
 )
 from repic_tpu.telemetry import events as tlm_events
+from repic_tpu.telemetry import probes as tlm_probes
 from repic_tpu.telemetry import server as tlm_server
+from repic_tpu.telemetry import trace as tlm_trace
 from repic_tpu.utils import box_io
 
 _log = tlm_events.get_logger("consensus")
@@ -1489,6 +1491,30 @@ def run_consensus_dir(
         out_dir,
         host=cluster_ctx.host if cluster_ctx is not None else None,
     )
+    # Synthetic root trace for CLI runs (docs/observability.md
+    # "Traces"): when no request-scoped context is active (the serve
+    # daemon activates one per job) this run gets its own, so the
+    # _trace.jsonl artifact, the trace ids on spans/journal records,
+    # and `repic-tpu trace` work identically for batch and served
+    # runs.  An already-active context (a caller orchestrating this
+    # run as part of a request) is respected, not replaced.
+    trace_ctx = trace_token = None
+    if tlm_trace.current() is None:
+        trace_ctx = tlm_trace.start(
+            out_dir,
+            kind="cli",
+            # cluster runs share out_dir: per-host artifact names,
+            # same scheme as the journal/event/metric files above
+            host=(
+                cluster_ctx.host if cluster_ctx is not None else None
+            ),
+            run_id=(
+                run_tlm.log.run_id
+                if run_tlm.log is not None
+                else None
+            ),
+        )
+        trace_token = tlm_trace.activate(trace_ctx)
     tlm_server.set_status(
         run_id=run_tlm.log.run_id if run_tlm.log is not None else None,
         out_dir=os.path.abspath(out_dir),
@@ -1548,7 +1574,8 @@ def run_consensus_dir(
         workers = min(32, max(4, os.cpu_count() or 4))
 
         def _load_many(nms):
-            with tlm_events.span("load", micrographs=len(nms)):
+            with tlm_trace.segment("load", micrographs=len(nms)), \
+                    tlm_events.span("load", micrographs=len(nms)):
                 if len(nms) > 1:
                     with ThreadPoolExecutor(max_workers=workers) as ex:
                         return list(ex.map(_load_one, nms))
@@ -1653,6 +1680,12 @@ def run_consensus_dir(
                     )
                 _MICROGRAPHS.inc()
                 compute_s += time.time() - t1
+                # striped execute carries compile inside it (one
+                # program per stripe config — no probe split here)
+                tlm_trace.add_segment(
+                    "execute", t1, time.time() - t1,
+                    micrograph=name, striped=True,
+                )
                 actual_stripes = giant["n_stripes"]
                 t2 = time.time()
                 sel = giant["picked"]
@@ -1688,6 +1721,9 @@ def run_consensus_dir(
                     + len(skipped)
                     + len(quarantined),
                     quarantined=len(quarantined),
+                )
+                tlm_trace.add_segment(
+                    "emit", t2, time.time() - t2, micrograph=name
                 )
             timer.stages.append(("compute", compute_s))
             timer.stages.append(("write", write_s))
@@ -1741,6 +1777,15 @@ def run_consensus_dir(
             """One pass of the chunked pipeline over a work list (the
             own shard first; cluster orphan batches after)."""
             nonlocal compute_s, write_s, num_cliques
+            # per-chunk trace segments mirror the serve worker's:
+            # the compile-probe delta inside a chunk window becomes
+            # the compile segment (joined to the RT105 cache-counter
+            # deltas), the rest is execute; the host-side tail
+            # (solve/write/journal/flush) is the emit segment
+            t_mark = time.time()
+            comp_mark = tlm_probes.compile_seconds()
+            hits_mark = _PROGRAM_HITS.value()
+            miss_mark = _PROGRAM_MISSES.value()
             for part, cbatch, res, extra, chunk_s in iter_consensus_chunks(
                 pending,
                 box_size,
@@ -1770,6 +1815,39 @@ def run_consensus_dir(
             ):
                 parts.append(len(part))
                 compute_s += chunk_s
+                t_now = time.time()
+                chunk_wall = max(t_now - t_mark, float(chunk_s), 0.0)
+                compile_seg = min(
+                    max(
+                        tlm_probes.compile_seconds() - comp_mark, 0.0
+                    ),
+                    chunk_wall,
+                )
+                hits_now = _PROGRAM_HITS.value()
+                miss_now = _PROGRAM_MISSES.value()
+                # also on a pure cache delta (marks advance every
+                # chunk — a warm chunk's hit must not be dropped)
+                if (
+                    len(parts) == 1
+                    or compile_seg > 0.0
+                    or hits_now > hits_mark
+                    or miss_now > miss_mark
+                ):
+                    tlm_trace.add_segment(
+                        "compile", t_now - chunk_wall, compile_seg,
+                        chunk=len(parts) - 1,
+                        cache_hits=int(hits_now - hits_mark),
+                        cache_misses=int(miss_now - miss_mark),
+                    )
+                tlm_trace.add_segment(
+                    "execute",
+                    t_now - chunk_wall + compile_seg,
+                    chunk_wall - compile_seg,
+                    chunk=len(parts) - 1,
+                    micrographs=len(part),
+                    capacity=cbatch.capacity,
+                )
+                t_emit0 = time.time()
                 if host_solver:
                     t_solve = time.time()
                     with tlm_events.span(
@@ -1876,6 +1954,17 @@ def run_consensus_dir(
                     quarantined=q_count,
                     ladder=ladder_tally,
                 )
+                # emit covers the whole host-side chunk tail (solve/
+                # write/journal/sink flush) so segments stay
+                # contiguous and their sum tracks the run wall time
+                tlm_trace.add_segment(
+                    "emit", t_emit0, time.time() - t_emit0,
+                    chunk=len(parts) - 1, micrographs=len(part),
+                )
+                t_mark = time.time()
+                comp_mark = tlm_probes.compile_seconds()
+                hits_mark = hits_now
+                miss_mark = miss_now
                 if cluster_ctx is not None:
                     # host_crash fault site + wedged-host exit: a
                     # fenced host must stop before touching the next
@@ -1930,6 +2019,9 @@ def run_consensus_dir(
         if cluster_ctx is not None:
             cluster_ctx.stop()
         telemetry.finish_run(run_tlm)
+        if trace_token is not None:
+            tlm_trace.deactivate(trace_token)
+            trace_ctx.close()
         # winding down = draining: readiness off, liveness stays up
         tlm_server.set_ready(False)
         tlm_server.set_status(phase="finished")

@@ -56,6 +56,7 @@ from repic_tpu.pipeline.consensus import (  # noqa: F401 - re-exports
     make_batched_consensus,
 )
 from repic_tpu.runtime.ladder import DEFAULT_POLICY, RetryPolicy
+from repic_tpu.telemetry import events as tlm_events
 
 
 @dataclass(frozen=True)
@@ -165,32 +166,37 @@ def plan_request(
     options = options or ConsensusOptions()
     if not loaded:
         raise ValueError("plan_request needs >= 1 loaded micrograph")
-    k = len(loaded[0][1])
-    nb = bucket_size(
-        max(bs.n for _, sets in loaded for bs in sets)
-    )
-    chunk = _auto_chunk(len(loaded), k, nb, n_dev)
-    names = [n for n, _ in loaded]
-    single = chunk >= len(loaded)
-    chunks = []
-    for idx, start in enumerate(range(0, len(names), chunk)):
-        part = tuple(names[start : start + chunk])
-        m = (
-            -(-len(part) // n_dev) * n_dev if single else chunk
+    # a telemetry span (not just wall time): planning inherits the
+    # active request trace, so a request's waterfall can be joined
+    # to the event stream all the way from accept to emit
+    with tlm_events.span("plan_request", micrographs=len(loaded),
+                         n_dev=n_dev):
+        k = len(loaded[0][1])
+        nb = bucket_size(
+            max(bs.n for _, sets in loaded for bs in sets)
         )
-        chunks.append(
-            ChunkPlan(
-                index=idx, names=part, capacity=nb, micrographs=m
+        chunk = _auto_chunk(len(loaded), k, nb, n_dev)
+        names = [n for n, _ in loaded]
+        single = chunk >= len(loaded)
+        chunks = []
+        for idx, start in enumerate(range(0, len(names), chunk)):
+            part = tuple(names[start : start + chunk])
+            m = (
+                -(-len(part) // n_dev) * n_dev if single else chunk
             )
+            chunks.append(
+                ChunkPlan(
+                    index=idx, names=part, capacity=nb, micrographs=m
+                )
+            )
+        return RequestPlan(
+            options=options,
+            num_pickers=k,
+            capacity=nb,
+            chunk=chunk,
+            n_dev=n_dev,
+            chunks=tuple(chunks),
         )
-    return RequestPlan(
-        options=options,
-        num_pickers=k,
-        capacity=nb,
-        chunk=chunk,
-        n_dev=n_dev,
-        chunks=tuple(chunks),
-    )
 
 
 def execute_request(

@@ -232,6 +232,16 @@ class RunJournal:
         return entry
 
     def _append(self, entry: dict) -> None:
+        # Request-scoped tracing: a journal record written while a
+        # trace context is active joins back to the originating
+        # request.  Lazy import (one sys.modules lookup per record)
+        # keeps the runtime <-> telemetry import graph acyclic, the
+        # same shape solve_host_ladder uses for its rung counter.
+        from repic_tpu.telemetry.trace import current_trace_id
+
+        tid = current_trace_id()
+        if tid is not None and "trace" not in entry:
+            entry["trace"] = tid
         if self._fh is None:
             self._fh = open(self.path, "at")
         self._fh.write(json.dumps(entry) + "\n")

@@ -52,6 +52,7 @@ from repic_tpu.serve.jobs import (
 )
 from repic_tpu.telemetry import events as tlm_events
 from repic_tpu.telemetry import server as tlm_server
+from repic_tpu.telemetry import trace as tlm_trace
 
 SERVE_INFO_NAME = "_serve.json"
 
@@ -63,7 +64,7 @@ _REQUESTS = telemetry.counter(
 )
 _JOB_SECONDS = telemetry.histogram(
     "repic_serve_job_seconds",
-    "wall-clock seconds per executed serve job",
+    "wall-clock seconds per executed serve job (by capacity bucket)",
 )
 
 
@@ -268,6 +269,7 @@ class ConsensusDaemon:
         breaker_threshold: int = 3,
         breaker_cooldown_s: float = 30.0,
         warmup: bool = True,
+        slo_targets: dict | None = None,
         clock=time.time,
     ):
         self.work_dir = os.path.abspath(work_dir)
@@ -275,6 +277,9 @@ class ConsensusDaemon:
         self.drain_grace_s = drain_grace_s
         self.do_warmup = warmup
         self._clock = clock
+        # rolling SLO view for /status (always on — without
+        # --slo-target objectives it still reports p50/p95/p99)
+        self.slo = tlm_server.SLOTracker(objectives=slo_targets)
         os.makedirs(self.work_dir, exist_ok=True)
         self.journal = ServeJournal(self.work_dir)
         self.queue = JobQueue(
@@ -300,6 +305,7 @@ class ConsensusDaemon:
     def start(self) -> "ConsensusDaemon":
         recovered = self.journal.recover()
         self.server.start()
+        tlm_server.set_slo_tracker(self.slo)
         self.journal.record_event(
             "server_started",
             pid=os.getpid(),
@@ -369,6 +375,8 @@ class ConsensusDaemon:
         if self._worker is not None:
             self._worker.join(timeout=self.drain_grace_s + 30.0)
         self.journal.record_event("drain_complete")
+        if tlm_server.get_slo_tracker() is self.slo:
+            tlm_server.set_slo_tracker(None)
         self.server.stop()
         self.journal.close()
 
@@ -426,7 +434,10 @@ class ConsensusDaemon:
                 # drains, with every health probe green
                 try:
                     job.error = self.queue.error_doc(e)
-                    self.queue.finish(
+                    # through _finish_job, not queue.finish: the SLO
+                    # plane must hear about THESE failures too —
+                    # they are the worst case it exists to surface
+                    self._finish_job(
                         job, JOB_FAILED, error=job.error
                     )
                 except Exception:  # the journal itself may be down
@@ -462,20 +473,81 @@ class ConsensusDaemon:
 
         return check
 
+    def _finish_job(self, job: Job, state: str, **fields):
+        """queue.finish + the job-latency SLO observation (accept ->
+        terminal, the user-visible latency; deadline/cancel outcomes
+        count as SLO violations only when an objective is set — they
+        are recorded ``ok=False`` either way so the burn rate sees
+        them)."""
+        from repic_tpu.serve.jobs import TERMINAL_STATES
+
+        self.queue.finish(job, state, **fields)
+        if state in TERMINAL_STATES:
+            tlm_server.observe_slo(
+                "job",
+                max(
+                    (job.finished_ts or self._clock())
+                    - job.accepted_ts,
+                    0.0,
+                ),
+                ok=state == JOB_FINISHED,
+                bucket=job.progress.get("capacity"),
+            )
+
     def _run_job(self, job: Job):
         """Execute one job through the engine; returns the warmed
         bucket key (or None).  Every exit path records a journal
         state — crash points between them are what the recovery
-        tests exercise."""
+        tests exercise.  The whole execution runs under the job's
+        trace context (minted at HTTP accept), so every span and
+        journal record joins back to the request, and the per-request
+        ``_trace.jsonl`` in the job directory gains the
+        queue_wait/plan/compile/execute/emit segments ``repic-tpu
+        trace`` renders."""
+        out_dir = self.job_dir(job.id)
+        self.queue.mark_running(job)
+        # everything from this real-time instant to the first chunk
+        # is the "plan" segment (trace/journal open, load, planning)
+        # — anchored HERE so the segment sum stays within a few ms
+        # of the job's wall time even for sub-100ms warm jobs
+        t_picked = time.time()
+        self.publish_status()
+        queue_wait = max(
+            (job.started_ts or job.accepted_ts) - job.accepted_ts,
+            0.0,
+        )
+        tlm_server.observe_slo("queue_wait", queue_wait)
+        os.makedirs(out_dir, exist_ok=True)
+        tctx = tlm_trace.start(
+            out_dir,
+            trace_id=job.trace_id,
+            kind="serve",
+            job=job.id,
+            accepted_ts=round(job.accepted_ts, 6),
+        )
+        # a job recovered from a pre-tracing journal gains an id here
+        job.trace_id = tctx.trace_id
+        token = tlm_trace.activate(tctx)
+        try:
+            tlm_trace.add_segment(
+                "queue_wait", job.accepted_ts, queue_wait
+            )
+            return self._run_job_traced(job, out_dir, t_picked)
+        finally:
+            tlm_trace.deactivate(token)
+            tctx.close()
+
+    def _run_job_traced(
+        self, job: Job, out_dir: str, t_picked: float
+    ):
         import numpy as np
 
         from repic_tpu.pipeline import engine
         from repic_tpu.runtime.journal import RunJournal, error_info
         from repic_tpu.runtime.ladder import ChunkOutcomes
+        from repic_tpu.telemetry import probes as tlm_probes
         from repic_tpu.utils import box_io
 
-        self.queue.mark_running(job)
-        self.publish_status()
         crash_point(f"run:{job.id}")
         t0 = self._clock()
         # a job that aged out while queued never touches the device
@@ -484,16 +556,16 @@ class ConsensusDaemon:
             and self._clock() > job.deadline_ts
         ):
             job.reason = "deadline exceeded while queued"
-            self.queue.finish(
+            self._finish_job(
                 job, JOB_DEADLINE_EXCEEDED, reason=job.reason
             )
             return None
         options = None
         bucket = None
-        out_dir = self.job_dir(job.id)
         rt = None
         run_journal = None
         try:
+            t_plan0 = t_picked
             options = engine.ConsensusOptions.from_dict(
                 job.request.get("options") or {}
             )
@@ -507,7 +579,6 @@ class ConsensusDaemon:
             names = box_io.micrograph_names(
                 os.path.join(in_dir, pickers[0])
             )
-            os.makedirs(out_dir, exist_ok=True)
             run_config = {
                 "in_dir": in_dir,
                 "box_size": np.asarray(box_size).tolist(),
@@ -585,6 +656,12 @@ class ConsensusDaemon:
                     "micrographs_total": len(names),
                     "micrographs_done": len(already) + len(counts),
                 }
+                tlm_trace.add_segment(
+                    "plan", t_plan0, time.time() - t_plan0,
+                    micrographs=len(names),
+                    chunks=len(plan.chunks),
+                    capacity=plan.capacity,
+                )
 
                 def _sink(fname, content):
                     with atomic_write(
@@ -601,31 +678,95 @@ class ConsensusDaemon:
                     outcomes=outcomes,
                     journal=journal,
                 )
+                # compile-vs-execute split per chunk: the compile
+                # probe delta inside the chunk window is the compile
+                # segment, joined to the RT105 program-cache counter
+                # deltas — a warm request shows cache_hits>0 and a
+                # near-zero compile segment
+                hits_c = telemetry.counter(
+                    "repic_program_cache_hits_total"
+                )
+                miss_c = telemetry.counter(
+                    "repic_program_cache_misses_total"
+                )
+                t_mark = time.time()
+                comp_mark = tlm_probes.compile_seconds()
+                hits_mark = hits_c.value()
+                miss_mark = miss_c.value()
                 for i, (part, cbatch, _res, packed, secs) in (
                     enumerate(chunks)
                 ):
-                    counts.update(
-                        engine.emit_box_chunk(
-                            cbatch, packed, box_size,
-                            num_particles=options.num_particles,
-                            sink=_sink,
-                        )
+                    now = time.time()
+                    chunk_wall = max(now - t_mark, float(secs), 0.0)
+                    compile_s = min(
+                        max(
+                            tlm_probes.compile_seconds() - comp_mark,
+                            0.0,
+                        ),
+                        chunk_wall,
                     )
-                    for nm, _sets in part:
-                        journal.record(
-                            nm,
-                            outcomes.status.get(nm, "ok"),
-                            wall_s=round(secs / max(len(part), 1), 6),
-                            solver=options.solver,
-                            particles=counts.get(nm),
-                            out=nm + ".box",
+                    hits_now = hits_c.value()
+                    miss_now = miss_c.value()
+                    # also on a pure cache delta: the marks advance
+                    # every chunk, so a warm chunk's hit would
+                    # otherwise be dropped and the trace undercount
+                    if (
+                        i == 0
+                        or compile_s > 0.0
+                        or hits_now > hits_mark
+                        or miss_now > miss_mark
+                    ):
+                        tlm_trace.add_segment(
+                            "compile", now - chunk_wall, compile_s,
+                            chunk=i,
+                            cache_hits=int(hits_now - hits_mark),
+                            cache_misses=int(miss_now - miss_mark),
                         )
-                    job.progress["chunks_done"] = i + 1
-                    job.progress["micrographs_done"] = (
-                        len(already) + len(counts)
+                    tlm_trace.add_segment(
+                        "execute",
+                        now - chunk_wall + compile_s,
+                        chunk_wall - compile_s,
+                        chunk=i,
+                        micrographs=len(part),
+                        capacity=cbatch.capacity,
                     )
-                    telemetry.flush_run(rt)
+                    # the emit segment covers the chunk's whole
+                    # host-side tail — artifact rendering, journal
+                    # records, AND the streaming sink flush — so the
+                    # segments stay contiguous and their sum tracks
+                    # the job wall time (the acceptance contract)
+                    with tlm_trace.segment(
+                        "emit", chunk=i, micrographs=len(part)
+                    ):
+                        counts.update(
+                            engine.emit_box_chunk(
+                                cbatch, packed, box_size,
+                                num_particles=options.num_particles,
+                                sink=_sink,
+                            )
+                        )
+                        for nm, _sets in part:
+                            journal.record(
+                                nm,
+                                outcomes.status.get(nm, "ok"),
+                                wall_s=round(
+                                    secs / max(len(part), 1), 6
+                                ),
+                                solver=options.solver,
+                                particles=counts.get(nm),
+                                out=nm + ".box",
+                            )
+                        job.progress["chunks_done"] = i + 1
+                        job.progress["micrographs_done"] = (
+                            len(already) + len(counts)
+                        )
+                        telemetry.flush_run(rt)
                     crash_point(f"run:{job.id}:chunk:{i}")
+                    t_mark = time.time()
+                    comp_mark = tlm_probes.compile_seconds()
+                    hits_mark = hits_now
+                    miss_mark = miss_now
+            t_finish0 = time.time()
             quarantined.update(outcomes.quarantined)
             job.result = {
                 "micrographs": len(names),
@@ -637,9 +778,15 @@ class ConsensusDaemon:
             }
             journal.close()
             crash_point(f"finish:{job.id}")
+            tlm_trace.add_segment(
+                "finish", t_finish0, time.time() - t_finish0
+            )
             wall = self._clock() - t0
-            _JOB_SECONDS.observe(wall)
-            self.queue.finish(
+            _JOB_SECONDS.observe(
+                wall,
+                bucket=str(job.progress.get("capacity", "none")),
+            )
+            self._finish_job(
                 job, JOB_FINISHED,
                 wall_s=round(wall, 3),
                 particles=job.result["particles"],
@@ -662,14 +809,14 @@ class ConsensusDaemon:
                 state = JOB_QUEUED
             else:
                 state = JOB_CANCELLED
-            self.queue.finish(job, state, reason=reason)
+            self._finish_job(job, state, reason=reason)
             return bucket
         except Exception as e:  # noqa: BLE001 - isolation boundary
             # request isolation: a poisoned job FAILS (journaled,
             # visible to its client, counted by the breaker); the
             # daemon and every other job keep going
             job.error = self.queue.error_doc(e)
-            self.queue.finish(job, JOB_FAILED, error=job.error)
+            self._finish_job(job, JOB_FAILED, error=job.error)
             self.queue.breaker.record_failure()
             _log.error(f"job {job.id} failed: {e}")
             return bucket

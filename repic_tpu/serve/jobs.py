@@ -26,6 +26,8 @@ from dataclasses import dataclass, field
 from repic_tpu import telemetry
 from repic_tpu.runtime import faults
 from repic_tpu.runtime.journal import _read_entries, error_info
+from repic_tpu.telemetry import server as tlm_server
+from repic_tpu.telemetry import trace as tlm_trace
 
 SERVE_JOURNAL_NAME = "_serve_journal.jsonl"
 
@@ -69,6 +71,19 @@ _BREAKER_TRIPS = telemetry.counter(
     "repic_serve_breaker_trips_total",
     "circuit breaker open transitions",
 )
+# One admission-outcome surface for dashboards: every submission
+# lands exactly once, labeled by outcome (accepted/rejected), the
+# cause, and the HTTP code the client saw — the scrape-side join of
+# the 202/429/503 contract (the per-reason _REJECTED counter above
+# stays for backward compatibility).
+_ADMISSION = telemetry.counter(
+    "repic_serve_admission_total",
+    "serve admission decisions (by outcome, cause, http code)",
+)
+_QUEUE_WAIT = telemetry.histogram(
+    "repic_serve_queue_wait_seconds",
+    "seconds an accepted job waited in the queue before running",
+)
 
 
 def crash_point(point: str) -> None:
@@ -104,6 +119,7 @@ class Job:
     request: dict                  # validated submission payload
     accepted_ts: float
     state: str = JOB_QUEUED
+    trace_id: str | None = None    # request-scoped tracing key
     deadline_ts: float | None = None
     bucket_hint: int | None = None
     started_ts: float | None = None
@@ -128,6 +144,8 @@ class Job:
             "finished_ts": self.finished_ts,
             "resumed": self.resumed,
         }
+        if self.trace_id is not None:
+            out["trace_id"] = self.trace_id
         if self.deadline_ts is not None:
             out["deadline_ts"] = self.deadline_ts
         if self.progress:
@@ -226,6 +244,9 @@ class ServeJournal:
                 id=jid,
                 request=first.get("request", {}),
                 accepted_ts=float(first.get("ts", time.time())),
+                # the original accept's trace id survives the crash:
+                # the re-run's spans/segments join the same request
+                trace_id=first.get("trace"),
                 deadline_ts=first.get("deadline_ts"),
                 bucket_hint=first.get("bucket_hint"),
                 resumed=state == JOB_RUNNING,
@@ -368,11 +389,17 @@ class JobQueue:
         """Admit one request or raise :class:`AdmissionError`."""
         if self.draining:
             _REJECTED.inc(reason="draining")
+            _ADMISSION.inc(
+                outcome="rejected", cause="draining", code="503"
+            )
             raise AdmissionError(503, "draining", 30.0)
         try:
             self.breaker.check_admission()
         except AdmissionError:
             _REJECTED.inc(reason="circuit_open")
+            _ADMISSION.inc(
+                outcome="rejected", cause="circuit_open", code="503"
+            )
             raise
         with self._lock:
             backlog = len(self._pending) + (
@@ -381,6 +408,10 @@ class JobQueue:
             stormed = faults.check("request_storm", "submit")
             if backlog >= self.limit or stormed:
                 _REJECTED.inc(reason="queue_full")
+                _ADMISSION.inc(
+                    outcome="rejected", cause="queue_full",
+                    code="429",
+                )
                 raise AdmissionError(
                     429,
                     "queue_full",
@@ -392,6 +423,9 @@ class JobQueue:
                 id=new_job_id(),
                 request=request,
                 accepted_ts=now,
+                # the trace id is minted AT ACCEPT: queue residency,
+                # execution, and emit all join back to this moment
+                trace_id=tlm_trace.new_trace_id(),
                 deadline_ts=(
                     now + deadline_s
                     if deadline_s is not None
@@ -407,11 +441,15 @@ class JobQueue:
                 request=request,
                 deadline_ts=job.deadline_ts,
                 bucket_hint=bucket_hint,
+                trace=job.trace_id,
             )
             self._jobs[job.id] = job
             self._pending.append(job.id)
             _DEPTH.set(len(self._pending))
         _ADMITTED.inc()
+        _ADMISSION.inc(
+            outcome="accepted", cause="accepted", code="202"
+        )
         crash_point(f"accept:{job.id}")
         self._wake.set()
         return job
@@ -476,7 +514,9 @@ class JobQueue:
                         0.7 * self._avg_job_s + 0.3 * dur
                     )
                 self._note_terminal(job.id)
-        self.journal.record(job.id, state, **fields)
+        self.journal.record(
+            job.id, state, trace=job.trace_id, **fields
+        )
         if state in TERMINAL_STATES:
             _JOBS.inc(state=state)
 
@@ -493,8 +533,12 @@ class JobQueue:
         with self._lock:
             job.state = JOB_RUNNING
             job.started_ts = self._clock()
+        _QUEUE_WAIT.observe(
+            max(job.started_ts - job.accepted_ts, 0.0)
+        )
         self.journal.record(
-            job.id, JOB_RUNNING, resumed=job.resumed
+            job.id, JOB_RUNNING, resumed=job.resumed,
+            trace=job.trace_id,
         )
 
     # -- client side --------------------------------------------------
@@ -548,7 +592,8 @@ class JobQueue:
                 # around, recover() would fold the finished job back
                 # to running and resurrect it.
                 self.journal.record(
-                    job_id, JOB_RUNNING, cancel_requested=True
+                    job_id, JOB_RUNNING, cancel_requested=True,
+                    trace=job.trace_id,
                 )
         if outright:
             # terminal under the lock above, so no concurrent
@@ -557,8 +602,18 @@ class JobQueue:
             self.journal.record(
                 job_id, JOB_CANCELLED,
                 reason="cancelled while queued",
+                trace=job.trace_id,
             )
             _JOBS.inc(state=JOB_CANCELLED)
+            # a queued cancel is terminal WITHOUT passing through the
+            # daemon's _finish_job, so the SLO plane must hear about
+            # it here — docs/serving.md: cancelled jobs count as
+            # violations (the client did not get a timely success)
+            tlm_server.observe_slo(
+                "job",
+                max(job.finished_ts - job.accepted_ts, 0.0),
+                ok=False,
+            )
         return job
 
     def begin_drain(self) -> int:

@@ -40,6 +40,7 @@ import time
 import uuid
 
 from repic_tpu.telemetry import metrics, probes
+from repic_tpu.telemetry import trace as _trace
 
 EVENTS_NAME = "_events.jsonl"
 
@@ -191,6 +192,11 @@ class _Span:
                 rec["device_tail_s"] = round(tail, 6)
             if exc_type is not None:
                 rec["error"] = exc_type.__name__
+            tid = _trace.current_trace_id()
+            if tid is not None:
+                # request-scoped tracing: the span joins back to the
+                # originating request (docs/observability.md "Traces")
+                rec["trace"] = tid
             rec.update(self.attrs)
             log.write(rec)
         return False  # never swallow
@@ -216,6 +222,9 @@ def event(name: str, **fields) -> None:
     stack = _SPAN_STACK.get()
     if stack:
         rec["span"] = stack[-1]
+    tid = _trace.current_trace_id()
+    if tid is not None:
+        rec["trace"] = tid
     rec.update(fields)
     log.write(rec)
 
@@ -267,6 +276,9 @@ class StructuredLogger:
                 "msg": msg,
                 "t": round(time.time(), 6),
             }
+            tid = _trace.current_trace_id()
+            if tid is not None:
+                rec["trace"] = tid
             rec.update(fields)
             log.write(rec)
 

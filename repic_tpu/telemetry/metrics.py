@@ -41,6 +41,18 @@ DEFAULT_BUCKETS = (
 )
 
 
+def percentile(values, q: float) -> float:
+    """Nearest-rank percentile (exact for the small sample counts a
+    run or rolling window produces; no interpolation surprises at
+    N=1).  The ONE quantile definition shared by ``report`` and the
+    SLO tracker — a future change applies everywhere at once."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    rank = max(int(math.ceil(q * len(ordered))) - 1, 0)
+    return float(ordered[min(rank, len(ordered) - 1)])
+
+
 def _env_enabled() -> bool:
     return os.environ.get("REPIC_TPU_TELEMETRY", "1").lower() not in (
         "0", "false", "off",

@@ -173,6 +173,13 @@ def counters() -> tuple[int, int, int]:
     return _compiles, _transfer_bytes, _transfer_fetches
 
 
+def compile_seconds() -> float:
+    """Cumulative XLA backend-compile wall seconds observed so far —
+    the delta the request tracer splits a chunk's compile segment out
+    of (``docs/observability.md`` "Traces")."""
+    return _compile_seconds
+
+
 def device_memory() -> dict:
     """Allocator stats of the first addressable device, or {}.
 

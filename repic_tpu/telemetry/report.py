@@ -21,27 +21,21 @@ per-device telemetry aggregated across a TPU fleet.
 from __future__ import annotations
 
 import json
-import math
 import os
 
 from repic_tpu.telemetry import devicetime as _devicetime
 from repic_tpu.telemetry import events as _events
 from repic_tpu.telemetry import sinks as _sinks
+from repic_tpu.telemetry import trace as _trace
+from repic_tpu.telemetry.metrics import percentile as _percentile
 
 #: version of the ``repic-tpu report --json`` field contract
 #: (docs/observability.md "Report JSON contract").  Bump on any
 #: breaking change to existing fields; additive sections don't bump.
-SCHEMA_VERSION = 2
-
-
-def _percentile(values: list[float], q: float) -> float:
-    """Nearest-rank percentile (exact for the small-N span counts
-    a run produces; no interpolation surprises at N=1)."""
-    if not values:
-        return 0.0
-    ordered = sorted(values)
-    rank = max(int(math.ceil(q * len(ordered))) - 1, 0)
-    return float(ordered[min(rank, len(ordered) - 1)])
+#: v3: the per-request ``requests`` section (trace-artifact join) —
+#: bumped (not additive) because consumers keying dashboards on the
+#: request latency split must be able to tell joined reports apart.
+SCHEMA_VERSION = 3
 
 
 def _stage_stats(durations: list[float]) -> dict:
@@ -283,6 +277,27 @@ def build_report(run_dir: str) -> dict:
     }
     if device_time:
         report["device_time"] = device_time
+
+    # -- per-request traces (_trace.jsonl, serve jobs + CLI runs) ----
+    trace_records = _trace.read_trace(run_dir)
+    if trace_records:
+        traces = {}
+        for tid, tr in _trace.summarize(trace_records).items():
+            row = {
+                "kind": tr.get("kind"),
+                "job": tr.get("job"),
+                "t0": tr.get("t0"),
+                "span_s": tr.get("span_s"),
+                "total_s": tr.get("total_s"),
+                "segments": tr.get("segment_totals", {}),
+            }
+            if tr.get("cache"):
+                row["cache"] = tr["cache"]
+            traces[tid] = row
+        report["requests"] = {
+            "count": len(traces),
+            "traces": traces,
+        }
     if clustered:
         cluster["hosts"] = dict(sorted(cluster["hosts"].items()))
         cluster["suspects"] = len(suspect_hosts)
@@ -435,6 +450,30 @@ def format_report(report: dict) -> str:
                 f"({tr['device_ops']} device op(s), "
                 f"gap={tr['dispatch_gap_s']:.3f}s)"
             )
+
+    req = report.get("requests")
+    if req:
+        lines.append(f"requests (traces): {req['count']}")
+        for tid, tr in sorted(req["traces"].items()):
+            segs = " ".join(
+                f"{k}={v:.3f}s"
+                for k, v in sorted(tr["segments"].items())
+            )
+            cache = tr.get("cache")
+            tail = (
+                f" cache_hits={cache['hits']}"
+                f" cache_misses={cache['misses']}"
+                if cache
+                else ""
+            )
+            job = f" job={tr['job']}" if tr.get("job") else ""
+            lines.append(
+                f"  {tid}{job} total={tr['total_s']:.3f}s "
+                f"{segs}{tail}"
+            )
+        lines.append(
+            "  (waterfall + critical path: repic-tpu trace <dir>)"
+        )
 
     if report["runtime_tsv"]:
         stages = " ".join(

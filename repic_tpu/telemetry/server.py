@@ -46,12 +46,191 @@ import contextlib
 import json
 import threading
 import time
+from collections import deque
 
 from repic_tpu.telemetry import metrics as _metrics
 
 _ACTIVE: "StatusServer | None" = None
 _STATUS: dict = {}
 _STATUS_LOCK = threading.Lock()
+_SLO: "SLOTracker | None" = None
+
+_HTTP_SECONDS = _metrics.histogram(
+    "repic_http_request_seconds",
+    "status/serve endpoint latency (by route)",
+)
+
+
+# -- SLO tracking ------------------------------------------------------
+
+
+def parse_slo_targets(specs) -> dict:
+    """``--slo-target`` parser: ``endpoint=seconds[@goal]`` specs.
+
+    ``job=60`` means "jobs should finish within 60 s"; the goal (the
+    fraction of requests that must meet the target, default 0.95)
+    rides after ``@``: ``queue_wait=5@0.99``.  Returns
+    ``{endpoint: (target_s, goal)}``; malformed specs raise
+    ``ValueError`` with the offending text (mapped to a CLI error).
+    """
+    out: dict = {}
+    for spec in specs or ():
+        try:
+            endpoint, rest = spec.split("=", 1)
+            if "@" in rest:
+                target_s, goal = rest.split("@", 1)
+            else:
+                target_s, goal = rest, "0.95"
+            endpoint = endpoint.strip()
+            target = float(target_s)
+            goal_f = float(goal)
+            if not endpoint or target <= 0 or not (0 < goal_f < 1):
+                raise ValueError
+        except ValueError:
+            raise ValueError(
+                f"bad --slo-target {spec!r} (want "
+                "endpoint=seconds[@goal], e.g. job=60@0.95)"
+            ) from None
+        out[endpoint] = (target, goal_f)
+    return out
+
+
+class SLOTracker:
+    """Rolling per-endpoint latency objectives + error-budget burn.
+
+    Keeps the last ``window`` observations per (endpoint, bucket) in
+    a deque — a ROLLING view, deliberately distinct from the
+    registry's cumulative histograms (which a scraper rates over
+    time): ``/status`` must answer "how are we doing right now"
+    without a Prometheus deployment.  ``summary()`` computes
+    p50/p95/p99 plus, for endpoints with a configured objective
+    (:func:`parse_slo_targets`), the compliance fraction and the
+    error-budget burn rate::
+
+        burn = violating_fraction / (1 - goal)
+
+    burn < 1 means the endpoint is within budget over the window;
+    burn = 3 means the budget is being spent 3x too fast — the
+    standard multi-window burn-rate alarm input (docs/serving.md has
+    the operator interpretation).  Thread-safe; ``observe`` is a
+    deque append under the lock, cheap enough for per-request use.
+    """
+
+    def __init__(self, objectives: dict | None = None,
+                 window: int = 512):
+        self.objectives = dict(objectives or {})
+        self.window = int(window)
+        self._lock = threading.Lock()
+        self._samples: dict = {}
+
+    def observe(self, endpoint: str, latency_s: float,
+                ok: bool = True, bucket=None) -> None:
+        key = (
+            str(endpoint),
+            None if bucket is None else str(bucket),
+        )
+        with self._lock:
+            dq = self._samples.get(key)
+            if dq is None:
+                dq = self._samples[key] = deque(maxlen=self.window)
+            dq.append((float(latency_s), bool(ok)))
+
+    def _stats(self, rows: list, objective) -> dict:
+        lats = [lat for lat, _ in rows]
+        out = {
+            "count": len(rows),
+            "p50_s": round(_metrics.percentile(lats, 0.50), 6),
+            "p95_s": round(_metrics.percentile(lats, 0.95), 6),
+            "p99_s": round(_metrics.percentile(lats, 0.99), 6),
+        }
+        if objective is not None and rows:
+            target, goal = objective
+            bad = sum(
+                1 for lat, ok in rows
+                if not ok or lat > target
+            )
+            violating = bad / len(rows)
+            out["target_s"] = target
+            out["goal"] = goal
+            out["compliance"] = round(1.0 - violating, 4)
+            out["budget_burn"] = round(
+                violating / max(1.0 - goal, 1e-9), 3
+            )
+        return out
+
+    def summary(self) -> dict:
+        """The ``/status`` SLO section: per-endpoint rolling stats
+        (aggregated over capacity buckets) with a per-bucket
+        breakdown where buckets were observed."""
+        with self._lock:
+            snap = {
+                key: list(dq) for key, dq in self._samples.items()
+            }
+        by_endpoint: dict = {}
+        for (endpoint, bucket), rows in snap.items():
+            slot = by_endpoint.setdefault(
+                endpoint, {"all": [], "buckets": {}}
+            )
+            slot["all"].extend(rows)
+            if bucket is not None:
+                slot["buckets"].setdefault(bucket, []).extend(rows)
+        endpoints = {}
+        for endpoint in sorted(by_endpoint):
+            slot = by_endpoint[endpoint]
+            objective = self.objectives.get(endpoint)
+            entry = self._stats(slot["all"], objective)
+            if slot["buckets"]:
+                entry["by_bucket"] = {
+                    b: self._stats(rows, objective)
+                    for b, rows in sorted(slot["buckets"].items())
+                }
+            endpoints[endpoint] = entry
+        return {
+            "window": self.window,
+            "objectives": {
+                ep: {"target_s": t, "goal": g}
+                for ep, (t, g) in sorted(self.objectives.items())
+            },
+            "endpoints": endpoints,
+        }
+
+
+def set_slo_tracker(tracker: "SLOTracker | None") -> "SLOTracker | None":
+    """Install the process-wide SLO tracker surfaced on ``/status``;
+    returns the previous one.  ``None`` removes the section."""
+    global _SLO
+    prev = _SLO
+    _SLO = tracker
+    return prev
+
+
+def get_slo_tracker() -> "SLOTracker | None":
+    return _SLO
+
+
+def observe_slo(endpoint: str, latency_s: float, ok: bool = True,
+                bucket=None) -> None:
+    """Record one observation on the active tracker (no-op without
+    one — the same near-zero disabled-mode contract as set_status)."""
+    if _SLO is not None:
+        _SLO.observe(endpoint, latency_s, ok=ok, bucket=bucket)
+
+
+def _route(path: str) -> str:
+    """Coarse endpoint label for the HTTP latency surface (bounded
+    cardinality: job ids must never become label values)."""
+    if path.startswith("/v1/jobs"):
+        parts = [p for p in path.split("/") if p][2:]
+        if not parts:
+            return "jobs"
+        if len(parts) >= 2 and parts[1] == "artifacts":
+            return "artifacts"
+        return "job"
+    if path.startswith("/healthz"):
+        return "healthz"
+    if path in ("/metrics", "/status"):
+        return path[1:]
+    return "other"
 
 
 def set_status(**fields) -> None:
@@ -128,6 +307,29 @@ class StatusServer:
 
             def _dispatch(self, method: str):
                 path = self.path.split("?", 1)[0]
+                # per-endpoint latency: time the whole handling,
+                # observe into the shared histogram + the SLO
+                # tracker's rolling window (both label by the
+                # bounded route, never by job id)
+                t0 = time.perf_counter()
+                self._last_code = 200
+                try:
+                    self._dispatch_inner(method, path)
+                except BaseException:
+                    # the client saw a dropped connection, not a
+                    # response — the SLO must count it as a failure
+                    self._last_code = 500
+                    raise
+                finally:
+                    route = _route(path)
+                    dur = time.perf_counter() - t0
+                    _HTTP_SECONDS.observe(dur, route=route)
+                    observe_slo(
+                        "http:" + route, dur,
+                        ok=self._last_code < 500,
+                    )
+
+            def _dispatch_inner(self, method: str, path: str):
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 if server.handle_request(self, method, path, body):
@@ -188,6 +390,7 @@ class StatusServer:
 
             def _send(self, code: int, ctype: str, body: str,
                       headers: dict | None = None):
+                self._last_code = code
                 data = body.encode("utf-8")
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
@@ -251,6 +454,8 @@ class StatusServer:
         computed per request when the run registered cluster info."""
         doc = get_status()
         doc["ts"] = time.time()
+        if _SLO is not None:
+            doc["slo"] = _SLO.summary()
         cluster = doc.get("cluster")
         if isinstance(cluster, dict) and cluster.get(
             "coordination_dir"

@@ -7,7 +7,7 @@ has been a by-eye exercise.  This script makes the comparison a
 command — and an advisory CI gate::
 
     python scripts/bench_compare.py BASELINE.json CURRENT.json \
-        [--threshold-pct 10] [--advisory]
+        [--threshold-pct 10] [--advisory] [--history FILE]
 
 Accepts either the driver-wrapper shape (``{"parsed": {...}}``, as
 the round artifacts are written) or a raw measurement row (one
@@ -18,6 +18,15 @@ Three headline fields are compared when both sides carry them:
 * ``warm_total_s`` (steady-state wall — lower is better)
 * ``first_call_s`` (compile-inclusive first call — lower is better)
 
+``--history FILE`` maintains the bench TRAJECTORY
+(``BENCH_HISTORY.jsonl``, seeded from the round artifacts): the
+current run's headline is appended (one JSON line with a timestamp
+and the metric name) and compared against the rolling median of the
+prior entries **of the same metric** — a two-point baseline diff
+catches a cliff, the rolling median catches the slow drift a noisy
+baseline pair hides.  History findings are ALWAYS advisory (printed,
+never the exit status): CI machines are noisy by design.
+
 Exit status: 0 OK / within threshold, 1 regression beyond
 ``--threshold-pct`` (0 with ``--advisory``), 2 unusable input.
 """
@@ -27,6 +36,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 # (field, higher_is_better) — compared when present on both sides
 FIELDS = (
@@ -85,6 +95,91 @@ def compare(baseline: dict, current: dict,
     return rows, regressions
 
 
+#: rolling-median window over prior same-metric history entries
+HISTORY_WINDOW = 10
+
+
+def read_history(path: str) -> list[dict]:
+    """History entries, tolerating a torn/garbled line (same contract
+    as every other JSONL artifact in this repo)."""
+    entries: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(entry, dict):
+                    entries.append(entry)
+    except OSError:
+        pass
+    return entries
+
+
+def update_history(
+    path: str,
+    row: dict,
+    threshold_pct: float,
+    window: int = HISTORY_WINDOW,
+    now=time.time,
+) -> tuple[list[str], list[str]]:
+    """Append ``row``'s headline to the trajectory and diff it against
+    the rolling median of the prior same-metric entries.
+
+    Returns ``(trend_lines, regressions)`` — regressions are fields
+    whose signed change vs the median exceeds the threshold.  The
+    append happens regardless (a regressed run is still a data
+    point), and entries of OTHER metrics never enter the median: the
+    CI fixture bench and the repo-headline bench share one file but
+    not one baseline.
+    """
+    metric = row.get("metric")
+    prior = [
+        e for e in read_history(path)
+        if e.get("metric") == metric
+    ][-window:]
+    lines, regressions = [], []
+    for field, higher_better in FIELDS:
+        cur = row.get(field)
+        if not isinstance(cur, (int, float)):
+            continue
+        vals = [
+            e[field] for e in prior
+            if isinstance(e.get(field), (int, float))
+        ]
+        if not vals:
+            lines.append(f"{field}: first recorded value {cur:g}")
+            continue
+        med = sorted(vals)[(len(vals) - 1) // 2]
+        if med == 0:
+            continue
+        raw_pct = (cur - med) / abs(med) * 100.0
+        change_pct = raw_pct if higher_better else -raw_pct
+        regressed = change_pct < -threshold_pct
+        trend = " ".join(f"{v:g}" for v in vals[-5:])
+        lines.append(
+            f"{field}: [{trend}] median {med:g} -> {cur:g} "
+            f"({change_pct:+.1f}%)"
+            + ("  REGRESSION vs rolling median" if regressed else "")
+        )
+        if regressed:
+            regressions.append(
+                f"{field}: {med:g} -> {cur:g} "
+                f"({change_pct:+.1f}% vs rolling median)"
+            )
+    entry = {"ts": round(float(now()), 3), "metric": metric}
+    for field, _ in FIELDS:
+        if isinstance(row.get(field), (int, float)):
+            entry[field] = row[field]
+    with open(path, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    return lines, regressions
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="diff two BENCH_*.json artifacts"
@@ -107,6 +202,15 @@ def main(argv=None) -> int:
         action="store_true",
         help="emit the comparison as JSON instead of text",
     )
+    parser.add_argument(
+        "--history",
+        metavar="FILE",
+        default=None,
+        help="bench-trajectory JSONL (e.g. BENCH_HISTORY.jsonl): "
+        "append the current headline and print its trend vs the "
+        "rolling median of prior same-metric entries (always "
+        "advisory — never affects the exit status)",
+    )
     args = parser.parse_args(argv)
 
     try:
@@ -127,21 +231,30 @@ def main(argv=None) -> int:
         )
         return 2
 
-    if args.json:
-        print(
-            json.dumps(
-                {
-                    "metric": current.get(
-                        "metric", baseline.get("metric")
-                    ),
-                    "threshold_pct": args.threshold_pct,
-                    "fields": rows,
-                    "regressions": regressions,
-                    "ok": not regressions,
-                },
-                indent=2,
-            )
+    history_lines: list[str] = []
+    history_regressions: list[str] = []
+    if args.history:
+        history_lines, history_regressions = update_history(
+            args.history, current, args.threshold_pct
         )
+
+    if args.json:
+        doc = {
+            "metric": current.get(
+                "metric", baseline.get("metric")
+            ),
+            "threshold_pct": args.threshold_pct,
+            "fields": rows,
+            "regressions": regressions,
+            "ok": not regressions,
+        }
+        if args.history:
+            doc["history"] = {
+                "path": args.history,
+                "trend": history_lines,
+                "regressions": history_regressions,
+            }
+        print(json.dumps(doc, indent=2))
     else:
         metric = current.get("metric") or baseline.get("metric")
         if metric:
@@ -160,6 +273,15 @@ def main(argv=None) -> int:
             )
         else:
             print(f"ok (threshold {args.threshold_pct:g}%)")
+        if args.history:
+            print(f"history trend ({args.history}):")
+            for line in history_lines:
+                print(f"  {line}")
+            if history_regressions:
+                print(
+                    f"  {len(history_regressions)} regression(s) vs "
+                    "rolling median [advisory]"
+                )
 
     if regressions and not args.advisory:
         return 1

@@ -82,6 +82,23 @@ needs_reference = pytest.mark.skipif(
 )
 
 
+@pytest.fixture(scope="session")
+def multiprocess_backend():
+    """Skip-with-reason gate for tests needing cross-process SPMD.
+
+    The sandbox's CPU backend cannot run multi-process computations
+    (known-failing since seed); a real two-worker probe decides
+    (tests/capability_probe.py), once per session, so the tests run
+    for real on backends that do support it."""
+    from capability_probe import multiprocess_supported
+
+    ok, reason = multiprocess_supported()
+    if not ok:
+        pytest.skip(
+            f"multiprocess SPMD unsupported by this backend: {reason}"
+        )
+
+
 def pytest_terminal_summary(terminalreporter):
     if not _lockcheck.installed():
         return

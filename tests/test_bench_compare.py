@@ -136,3 +136,115 @@ def test_fixture_bench_emits_comparable_artifact(tmp_path):
     assert {f["field"] for f in doc["fields"]} == {
         "value", "warm_total_s", "first_call_s",
     }
+
+
+# -- bench trajectory (--history) -------------------------------------
+
+
+def test_history_appends_and_prints_trend(tmp_path):
+    """--history appends the current headline and prints the trend
+    vs the rolling median; first entry is labeled as such."""
+    hist = tmp_path / "BENCH_HISTORY.jsonl"
+    a = _artifact(tmp_path, "a.json")
+    b = _artifact(tmp_path, "b.json", value=49.0)
+    rc, out, _ = _run(a, b, "--history", str(hist))
+    assert rc == 0
+    assert "first recorded value" in out
+    entries = [
+        json.loads(line) for line in hist.read_text().splitlines()
+    ]
+    assert len(entries) == 1
+    assert entries[0]["value"] == 49.0
+    assert entries[0]["metric"] == "test metric"
+    # second run: trend vs the rolling median of the prior entry
+    rc, out, _ = _run(a, b, "--history", str(hist))
+    assert rc == 0
+    assert "median" in out
+    assert len(hist.read_text().splitlines()) == 2
+
+
+def test_history_regression_is_advisory_only(tmp_path):
+    """A collapse vs the rolling median is printed but NEVER the
+    exit status — and the regressed run still lands in the file (a
+    regressed run is still a data point)."""
+    from scripts.bench_compare import update_history
+
+    hist = tmp_path / "h.jsonl"
+    for v in (50.0, 52.0, 48.0):
+        update_history(
+            str(hist),
+            {"metric": "m", "value": v},
+            threshold_pct=10.0,
+            now=lambda: 1.0,
+        )
+    lines, regressions = update_history(
+        str(hist),
+        {"metric": "m", "value": 10.0},  # -80% vs median 50
+        threshold_pct=10.0,
+        now=lambda: 2.0,
+    )
+    assert regressions and "value" in regressions[0]
+    assert len(hist.read_text().splitlines()) == 4
+    # the CLI keeps exit 0 for a history-only regression: prior
+    # entries of the CLI metric at value 100, a baseline pair whose
+    # own diff is within threshold (-2%) — only the median trips
+    for _ in range(3):
+        update_history(
+            str(hist),
+            {"metric": "test metric", "value": 100.0},
+            threshold_pct=10.0,
+            now=lambda: 3.0,
+        )
+    a = _artifact(tmp_path, "a.json")
+    b = _artifact(tmp_path, "b.json", value=49.0)
+    rc, out, _ = _run(a, b, "--history", str(hist))
+    assert rc == 0
+    assert "REGRESSION vs rolling median" in out
+
+
+def test_history_median_is_per_metric(tmp_path):
+    """Entries of OTHER metrics never enter the median: the fixture
+    bench and the repo-headline bench share one file, not one
+    baseline."""
+    from scripts.bench_compare import update_history
+
+    hist = tmp_path / "h.jsonl"
+    update_history(
+        str(hist), {"metric": "other", "value": 1000.0},
+        threshold_pct=10.0, now=lambda: 1.0,
+    )
+    lines, regressions = update_history(
+        str(hist), {"metric": "m", "value": 50.0},
+        threshold_pct=10.0, now=lambda: 2.0,
+    )
+    assert not regressions
+    assert any("first recorded value" in ln for ln in lines)
+
+
+def test_history_tolerates_torn_line(tmp_path):
+    from scripts.bench_compare import read_history, update_history
+
+    hist = tmp_path / "h.jsonl"
+    update_history(
+        str(hist), {"metric": "m", "value": 50.0},
+        threshold_pct=10.0, now=lambda: 1.0,
+    )
+    with open(hist, "a") as f:
+        f.write('{"metric": "m", "val')  # torn append
+    assert len(read_history(str(hist))) == 1
+    lines, _ = update_history(
+        str(hist), {"metric": "m", "value": 51.0},
+        threshold_pct=10.0, now=lambda: 2.0,
+    )
+    assert any("median" in ln for ln in lines)
+
+
+def test_checked_in_history_is_readable():
+    """The seeded BENCH_HISTORY.jsonl parses and carries the round
+    trajectory (the trend line CI prints)."""
+    from scripts.bench_compare import read_history
+
+    entries = read_history(os.path.join(ROOT, "BENCH_HISTORY.jsonl"))
+    assert len(entries) >= 4
+    assert all("metric" in e and "ts" in e for e in entries)
+    assert any("mini10017" in e["metric"] for e in entries)

@@ -1,0 +1,83 @@
+"""Unit tests for the multiprocess-capability probe (conftest's
+``multiprocess_backend`` skip gate for tests/test_distributed.py).
+
+The classifier half is pure and tested on crafted worker outputs;
+the real two-worker probe runs once (session-cached) and its verdict
+is cross-checked against the one observable invariant that holds on
+every backend: the verdict is a (bool, reason) pair and the reason
+is non-empty.
+"""
+
+import capability_probe as cp
+
+
+def test_classify_success_needs_marker_and_zero_exits():
+    ok, reason = cp.classify_probe(
+        [0, 0],
+        [f"noise\n{cp.PROBE_OK_MARKER}\n", cp.PROBE_OK_MARKER],
+    )
+    assert ok is True
+    assert reason
+
+
+def test_classify_surfaces_backend_reason():
+    """The backend's own diagnostic becomes the skip reason — the
+    sandbox shape (CPU backend, multiprocess unimplemented)."""
+    err = (
+        "jaxlib.xla_extension.XlaRuntimeError: INVALID_ARGUMENT: "
+        "Multiprocess computations aren't implemented on the CPU "
+        "backend.\n"
+    )
+    ok, reason = cp.classify_probe([1, 1], [err, err])
+    assert ok is False
+    assert reason.startswith("Multiprocess computations")
+
+
+def test_classify_nonzero_exit_without_diagnostic():
+    ok, reason = cp.classify_probe(
+        [0, 23], ["fine", "died\nlast line here"]
+    )
+    assert ok is False
+    assert "exited 23" in reason and "last line here" in reason
+
+
+def test_classify_zero_exit_without_marker_is_failure():
+    """A worker that exits 0 without round-tripping the computation
+    (e.g. silently skipped) must not read as capability present."""
+    ok, reason = cp.classify_probe([0, 0], ["", ""])
+    assert ok is False
+    assert "marker" in reason
+
+
+def test_classify_timeout_marker_is_failure():
+    ok, _ = cp.classify_probe(
+        [-9, 0],
+        ["[probe timeout]", cp.PROBE_OK_MARKER],
+    )
+    assert ok is False
+
+
+def test_probe_is_cached(monkeypatch):
+    """multiprocess_supported probes at most once per process."""
+    calls = []
+
+    def fake_probe(timeout_s=120.0):
+        calls.append(1)
+        return (False, "fake")
+
+    monkeypatch.setattr(
+        cp, "probe_multiprocess_support", fake_probe
+    )
+    monkeypatch.setattr(cp, "_CACHE", None)
+    assert cp.multiprocess_supported() == (False, "fake")
+    assert cp.multiprocess_supported() == (False, "fake")
+    assert len(calls) == 1
+
+
+def test_real_probe_verdict_shape():
+    """The real probe (cached for the session — the distributed
+    tests' skip gate reuses this verdict) returns a well-formed
+    (bool, non-empty reason) pair on every backend."""
+    ok, reason = cp.multiprocess_supported()
+    assert isinstance(ok, bool)
+    assert isinstance(reason, str) and reason

@@ -420,7 +420,7 @@ def test_consensus_cli_device_time_and_status_port(tmp_path, rng):
         assert (out_dir / f"{name}.box").exists()
     report = build_report(str(out_dir))
     assert "consensus_chunk" in report["device_time"]["stages"]
-    assert report["schema_version"] == 2
+    assert report["schema_version"] == 3
     # the profiler session ran and left a trace directory the event
     # stream points at
     assert trace_dir.exists()

@@ -48,6 +48,7 @@ def test_initialize_survives_private_module_removal(monkeypatch):
 
 
 @pytest.mark.slow
+@pytest.mark.usefixtures("multiprocess_backend")
 def test_throughput_bench_end_to_end(tmp_path):
     """bench_distributed.py must run both measurements and emit a
     well-formed JSON line.  No timing gate: on this 1-core container
@@ -164,6 +165,7 @@ def test_two_process_striped_giant_matches_single(tmp_path):
 
 
 @pytest.mark.slow
+@pytest.mark.usefixtures("multiprocess_backend")
 def test_two_process_consensus_matches_single(tmp_path):
     port = _free_port()
     workers = []

@@ -221,13 +221,14 @@ def test_events_stream_has_run_id_and_chunk_spans(journaled_run):
 
 def test_report_json_carries_schema_version(journaled_run, capsys):
     """Satellite: the --json output pins its field contract
-    (docs/observability.md "Report JSON contract")."""
+    (docs/observability.md "Report JSON contract").  v3 added the
+    per-request ``requests`` section (trace-artifact join)."""
     out_dir, _ = journaled_run
     report = build_report(out_dir)
-    assert report["schema_version"] == 2
+    assert report["schema_version"] == 3
     cli_main(["report", out_dir, "--json"])
     doc = json.loads(capsys.readouterr().out)
-    assert doc["schema_version"] == 2
+    assert doc["schema_version"] == 3
 
 
 def test_report_merges_per_host_metrics_and_events(tmp_path):

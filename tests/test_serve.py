@@ -350,6 +350,11 @@ def test_worker_survives_journal_failure(daemon, monkeypatch):
     assert code == 202, body
     doc2 = _wait_terminal(port, json.loads(body)["id"])
     assert doc2["state"] == "finished", doc2
+    # and the SLO plane heard about the escape-path failure too —
+    # the last-resort branch goes through _finish_job, so /status
+    # compliance cannot read 1.0 while every job is dying there
+    job_ep = daemon.slo.summary()["endpoints"]["job"]
+    assert job_ep["count"] == 2, job_ep
 
 
 def test_submission_validation_maps_to_400(daemon):

@@ -1,0 +1,430 @@
+"""Request-scoped tracing: context propagation, the per-request
+trace artifact, `repic-tpu trace`, and the SLO plane.
+
+The ISSUE 10 acceptance surface: a serve job's trace id flows from
+HTTP accept across the worker-thread handoff into every span /
+journal record / trace segment; the torn artifact a crashed job
+leaves still renders a partial waterfall; the SLO tracker turns
+rolling observations into p50/p95/p99 + error-budget burn on
+`/status`.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from repic_tpu.main import main as cli_main
+from repic_tpu.telemetry import server as tlm_server
+from repic_tpu.telemetry import trace as tlm_trace
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "mini10017"
+)
+
+
+# -- trace context + artifact ----------------------------------------
+
+
+def test_scope_writes_root_and_segments(tmp_path):
+    out = str(tmp_path)
+    with tlm_trace.scope(out, kind="cli", job="j1") as ctx:
+        assert tlm_trace.current_trace_id() == ctx.trace_id
+        tlm_trace.add_segment("plan", 1.0, 0.25, micrographs=3)
+        with tlm_trace.segment("emit", chunk=0):
+            time.sleep(0.01)
+    assert tlm_trace.current_trace_id() is None
+    records = tlm_trace.read_trace(out)
+    assert [r["ev"] for r in records] == [
+        "trace", "segment", "segment"
+    ]
+    root, plan, emit = records
+    assert root["kind"] == "cli" and root["job"] == "j1"
+    assert {r["trace"] for r in records} == {ctx.trace_id}
+    assert plan["seg"] == "plan" and plan["dur_s"] == 0.25
+    assert emit["seg"] == "emit" and emit["dur_s"] >= 0.01
+
+
+def test_add_segment_is_noop_without_active_context(tmp_path):
+    tlm_trace.add_segment("execute", 0.0, 1.0)  # must not raise
+    ctx = tlm_trace.start(None)  # id-only context, no artifact
+    token = tlm_trace.activate(ctx)
+    try:
+        tlm_trace.add_segment("execute", 0.0, 1.0)
+    finally:
+        tlm_trace.deactivate(token)
+        ctx.close()
+    assert not os.path.exists(tlm_trace.trace_path(str(tmp_path)))
+
+
+def test_spans_events_and_journal_records_carry_trace_id(tmp_path):
+    """While a context is active, the whole telemetry plane joins to
+    the request: span exits, point events, log records, and run-
+    journal appends all carry the trace id — and none of them do
+    once the context is gone."""
+    from repic_tpu import telemetry
+    from repic_tpu.runtime.journal import RunJournal
+    from repic_tpu.telemetry import events as tlm_events
+
+    out = str(tmp_path)
+    rt = telemetry.start_run(out)
+    try:
+        with tlm_trace.scope(out, kind="cli") as ctx:
+            with tlm_events.span("traced_stage"):
+                pass
+            tlm_events.event("traced_event")
+            j = RunJournal(out)
+            j.record("mic0", "ok")
+            j.close()
+        with tlm_events.span("untraced_stage"):
+            pass
+    finally:
+        telemetry.finish_run(rt)
+    events = tlm_events.read_events(out)
+    by_name = {r.get("name"): r for r in events if "name" in r}
+    assert by_name["traced_stage"]["trace"] == ctx.trace_id
+    assert by_name["traced_event"]["trace"] == ctx.trace_id
+    assert "trace" not in by_name["untraced_stage"]
+    journal = [
+        json.loads(line)
+        for line in open(os.path.join(out, "_journal.jsonl"))
+    ]
+    assert journal[0]["trace"] == ctx.trace_id
+
+
+def test_thread_target_propagates_context(tmp_path):
+    """threading.Thread does not inherit contextvars; thread_target
+    captures the caller's context so a hand-rolled handoff keeps the
+    trace id."""
+    seen = {}
+
+    def probe(key):
+        seen[key] = tlm_trace.current_trace_id()
+
+    with tlm_trace.scope(str(tmp_path)) as ctx:
+        bare = threading.Thread(target=probe, args=("bare",))
+        bound = threading.Thread(
+            target=tlm_trace.thread_target(probe, "bound")
+        )
+        bare.start(), bound.start()
+        bare.join(), bound.join()
+    assert seen["bare"] is None
+    assert seen["bound"] == ctx.trace_id
+
+
+def test_summarize_totals_cache_and_span(tmp_path):
+    recs = [
+        {"ev": "trace", "trace": "t1", "t": 10.0, "kind": "serve",
+         "job": "j1"},
+        {"ev": "segment", "trace": "t1", "seg": "queue_wait",
+         "t": 10.0, "dur_s": 1.0},
+        {"ev": "segment", "trace": "t1", "seg": "compile",
+         "t": 11.0, "dur_s": 2.0, "cache_hits": 0,
+         "cache_misses": 3},
+        {"ev": "segment", "trace": "t1", "seg": "execute",
+         "t": 13.0, "dur_s": 0.5},
+        {"ev": "segment", "trace": "t1", "seg": "execute",
+         "t": 13.5, "dur_s": 0.5},
+    ]
+    tr = tlm_trace.summarize(recs)["t1"]
+    assert tr["kind"] == "serve" and tr["job"] == "j1"
+    assert tr["segment_totals"] == {
+        "queue_wait": 1.0, "compile": 2.0, "execute": 1.0
+    }
+    assert tr["total_s"] == pytest.approx(4.0)
+    assert tr["span_s"] == pytest.approx(4.0)  # 10.0 -> 14.0
+    assert tr["cache"] == {"hits": 0, "misses": 3}
+
+
+def test_critical_path_serial_and_overlapping():
+    serial = [
+        {"seg": "a", "t": 0.0, "dur_s": 1.0},
+        {"seg": "b", "t": 1.0, "dur_s": 2.0},
+        {"seg": "c", "t": 3.0, "dur_s": 0.5},
+    ]
+    assert [s["seg"] for s in tlm_trace.critical_path(serial)] == [
+        "a", "b", "c"
+    ]
+    # an overlapped short segment never makes the path; a real gap
+    # jumps to the next segment
+    overlap = [
+        {"seg": "a", "t": 0.0, "dur_s": 2.0},
+        {"seg": "inner", "t": 0.5, "dur_s": 0.5},
+        {"seg": "late", "t": 5.0, "dur_s": 1.0},
+    ]
+    assert [s["seg"] for s in tlm_trace.critical_path(overlap)] == [
+        "a", "late"
+    ]
+    assert tlm_trace.critical_path([]) == []
+
+
+def test_torn_tail_artifact_still_renders(tmp_path, capsys):
+    """Crash mid-job: the trace artifact tears at the trailing line
+    and `repic-tpu trace` still renders the partial waterfall."""
+    out = str(tmp_path)
+    with tlm_trace.scope(out, kind="serve", job="j9") as ctx:
+        tlm_trace.add_segment("queue_wait", 100.0, 0.5)
+        tlm_trace.add_segment("execute", 100.5, 2.0, chunk=0)
+    with open(tlm_trace.trace_path(out), "a") as f:
+        f.write('{"ev": "segment", "trace": "' + ctx.trace_id)
+    records = tlm_trace.read_trace(out)
+    assert len(records) == 3  # torn line dropped, prefix kept
+    cli_main(["trace", out])
+    rendered = capsys.readouterr().out
+    assert ctx.trace_id in rendered
+    assert "queue_wait" in rendered and "execute[0]" in rendered
+    assert "critical path" in rendered
+
+
+def test_trace_cli_json_and_missing_artifact(tmp_path, capsys):
+    out = str(tmp_path)
+    with tlm_trace.scope(out, kind="cli") as ctx:
+        tlm_trace.add_segment("execute", 1.0, 1.0)
+    cli_main(["trace", out, "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["traces"][ctx.trace_id]["segment_totals"] == {
+        "execute": 1.0
+    }
+    with pytest.raises(SystemExit):
+        cli_main(["trace", str(tmp_path / "nowhere")])
+
+
+def test_cluster_per_host_trace_files_merge(tmp_path):
+    """Cluster runs share one out_dir: each host writes its own
+    ``_trace.<host>.jsonl`` (the journal/event scheme — N appenders
+    on one file would interleave) and read_trace merges them."""
+    out = str(tmp_path)
+    tids = {}
+    for host in ("h1", "h2"):
+        ctx = tlm_trace.start(out, host=host, kind="cli")
+        token = tlm_trace.activate(ctx)
+        try:
+            tlm_trace.add_segment("execute", 1.0, 1.0)
+        finally:
+            tlm_trace.deactivate(token)
+            ctx.close()
+        tids[host] = ctx.trace_id
+    assert not os.path.exists(tlm_trace.trace_path(out))
+    for host in ("h1", "h2"):
+        assert os.path.exists(tlm_trace.trace_path(out, host=host))
+    summaries = tlm_trace.summarize(tlm_trace.read_trace(out))
+    assert set(summaries) == set(tids.values())
+
+
+def test_trace_cli_lists_jobs_in_work_dir(tmp_path, capsys):
+    """Pointed at a serve work_dir without a job id, the command
+    lists the jobs that carry trace artifacts."""
+    jobs = tmp_path / "jobs"
+    for jid in ("j1", "j2"):
+        with tlm_trace.scope(str(jobs / jid), job=jid):
+            tlm_trace.add_segment("execute", 1.0, 1.0)
+    os.makedirs(jobs / "j3")  # no artifact -> not listed
+    cli_main(["trace", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "j1" in out and "j2" in out and "j3" not in out
+    cli_main(["trace", str(tmp_path), "j2"])
+    assert "execute" in capsys.readouterr().out
+
+
+# -- SLO plane --------------------------------------------------------
+
+
+def test_parse_slo_targets():
+    from repic_tpu.telemetry.server import parse_slo_targets
+
+    assert parse_slo_targets(None) == {}
+    assert parse_slo_targets(
+        ["job=60", "queue_wait=5@0.99"]
+    ) == {"job": (60.0, 0.95), "queue_wait": (5.0, 0.99)}
+    for bad in ("job", "job=0", "job=10@1.5", "=5", "job=x"):
+        with pytest.raises(ValueError):
+            parse_slo_targets([bad])
+
+
+def test_slo_tracker_percentiles_and_burn():
+    tracker = tlm_server.SLOTracker(
+        objectives={"job": (1.0, 0.9)}, window=100
+    )
+    # 8 fast + 2 over-target -> 20% violating, budget 10% -> burn 2x
+    for _ in range(8):
+        tracker.observe("job", 0.5)
+    tracker.observe("job", 3.0)
+    tracker.observe("job", 4.0, ok=False)
+    ep = tracker.summary()["endpoints"]["job"]
+    assert ep["count"] == 10
+    assert ep["p50_s"] == pytest.approx(0.5)
+    assert ep["p99_s"] == pytest.approx(4.0)
+    assert ep["compliance"] == pytest.approx(0.8)
+    assert ep["budget_burn"] == pytest.approx(2.0)
+    # an endpoint without an objective reports percentiles only
+    tracker.observe("queue_wait", 0.1)
+    qw = tracker.summary()["endpoints"]["queue_wait"]
+    assert "budget_burn" not in qw and qw["p50_s"] > 0
+
+
+def test_slo_tracker_buckets_and_window():
+    tracker = tlm_server.SLOTracker(window=4)
+    for cap, lat in ((256, 0.1), (256, 0.2), (512, 1.0)):
+        tracker.observe("job", lat, bucket=cap)
+    ep = tracker.summary()["endpoints"]["job"]
+    assert set(ep["by_bucket"]) == {"256", "512"}
+    assert ep["by_bucket"]["512"]["p50_s"] == pytest.approx(1.0)
+    # the rolling window keeps only the newest entries per key
+    for i in range(10):
+        tracker.observe("job", float(i), bucket=256)
+    assert (
+        tracker.summary()["endpoints"]["job"]["by_bucket"]["256"][
+            "count"
+        ]
+        == 4
+    )
+
+
+def test_observe_slo_noop_without_tracker():
+    assert tlm_server.get_slo_tracker() is None
+    tlm_server.observe_slo("job", 1.0)  # must not raise
+
+
+def test_queued_cancel_counts_as_slo_violation(tmp_path):
+    """A job cancelled while queued reaches terminal without passing
+    through the daemon's _finish_job — the queue itself must feed
+    the SLO plane, or compliance overstates health
+    (docs/serving.md: cancelled jobs count as violations)."""
+    from repic_tpu.serve.jobs import JobQueue, ServeJournal
+
+    tracker = tlm_server.SLOTracker(objectives={"job": (60.0, 0.9)})
+    prev = tlm_server.set_slo_tracker(tracker)
+    try:
+        q = JobQueue(4, ServeJournal(str(tmp_path)))
+        job = q.submit({"in_dir": "x"})
+        q.cancel(job.id)
+        ep = tracker.summary()["endpoints"]["job"]
+        assert ep["count"] == 1
+        assert ep["compliance"] == 0.0
+    finally:
+        tlm_server.set_slo_tracker(prev)
+
+
+def test_status_endpoint_reports_slo_section():
+    tracker = tlm_server.SLOTracker(objectives={"job": (60.0, 0.95)})
+    tracker.observe("job", 1.5)
+    prev = tlm_server.set_slo_tracker(tracker)
+    srv = tlm_server.StatusServer(port=0).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/status", timeout=5
+        ) as resp:
+            doc = json.loads(resp.read().decode())
+        slo = doc["slo"]
+        assert slo["objectives"]["job"]["target_s"] == 60.0
+        assert slo["endpoints"]["job"]["p95_s"] > 0
+        # the HTTP handling itself feeds the rolling view: the
+        # first scrape predates its own observation, so scrape again
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/status", timeout=5
+        ) as resp:
+            doc = json.loads(resp.read().decode())
+        assert "http:status" in doc["slo"]["endpoints"]
+    finally:
+        srv.stop()
+        tlm_server.set_slo_tracker(prev)
+
+
+def test_route_labels_are_bounded():
+    from repic_tpu.telemetry.server import _route
+
+    assert _route("/v1/jobs") == "jobs"
+    assert _route("/v1/jobs/abc123") == "job"
+    assert _route("/v1/jobs/abc123/artifacts") == "artifacts"
+    assert _route("/v1/jobs/abc123/artifacts/m1.box") == "artifacts"
+    assert _route("/healthz/ready") == "healthz"
+    assert _route("/metrics") == "metrics"
+    assert _route("/status") == "status"
+    assert _route("/favicon.ico") == "other"
+
+
+# -- daemon handoff (integration) ------------------------------------
+
+
+@pytest.mark.slow
+def test_serve_job_trace_follows_worker_handoff(tmp_path):
+    """The tentpole end-to-end, in process: the trace id minted at
+    HTTP accept survives the queue residency and the worker-thread
+    handoff; the job directory's artifact carries contiguous
+    queue_wait/plan/compile|execute/emit segments whose sum tracks
+    the job wall time; journal + span records join by the same id."""
+    from repic_tpu.serve.daemon import ConsensusDaemon
+    from repic_tpu.telemetry import events as tlm_events
+
+    d = ConsensusDaemon(
+        str(tmp_path / "wd"), port=0, warmup=False,
+        slo_targets={"job": (300.0, 0.95)},
+    )
+    d.start()
+    try:
+        body = json.dumps({
+            "in_dir": FIXTURE, "box_size": 180,
+            "options": {"use_mesh": False},
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{d.server.port}/v1/jobs",
+            data=body, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            accepted = json.loads(resp.read().decode())
+        tid = accepted["trace_id"]
+        assert tid
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{d.server.port}/v1/jobs/"
+                + accepted["id"],
+                timeout=10,
+            ) as resp:
+                doc = json.loads(resp.read().decode())
+            if doc["state"] in (
+                "finished", "failed", "cancelled",
+                "deadline_exceeded",
+            ):
+                break
+            time.sleep(0.1)
+        assert doc["state"] == "finished", doc
+        job_dir = os.path.join(
+            str(tmp_path / "wd"), "jobs", accepted["id"]
+        )
+        summaries = tlm_trace.summarize(
+            tlm_trace.read_trace(job_dir)
+        )
+        assert list(summaries) == [tid]
+        tr = summaries[tid]
+        segs = tr["segment_totals"]
+        assert {"queue_wait", "plan", "execute", "emit"} <= set(segs)
+        # acceptance: segment sum within 10% of the job wall time
+        wall = doc["finished_ts"] - doc["accepted_ts"]
+        assert tr["total_s"] == pytest.approx(wall, rel=0.10)
+        # journal + spans in the job dir joined by the same id
+        journal = [
+            json.loads(line)
+            for line in open(
+                os.path.join(job_dir, "_journal.jsonl")
+            )
+        ]
+        assert journal and all(
+            r.get("trace") == tid for r in journal
+        )
+        spans = [
+            r
+            for r in tlm_events.read_events(job_dir)
+            if r.get("ev") == "span"
+        ]
+        assert spans and all(r.get("trace") == tid for r in spans)
+        # the SLO plane saw the job land
+        slo = d.slo.summary()["endpoints"]
+        assert slo["job"]["count"] == 1
+        assert slo["job"]["compliance"] == 1.0
+        assert slo["queue_wait"]["count"] == 1
+    finally:
+        d.drain()
