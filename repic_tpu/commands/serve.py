@@ -80,6 +80,46 @@ def add_arguments(parser):
         "immediately and the first request pays the first compile",
     )
     parser.add_argument(
+        "--fleet-dir",
+        default=None,
+        metavar="DIR",
+        help="join (or found) a serving FLEET: a shared directory "
+        "holding the durable job queue (per-replica request "
+        "journals merged on read), per-job leases, completion "
+        "tokens, replica heartbeats/fences, and the shared jobs/ "
+        "output tree.  Start N replicas with the same --fleet-dir "
+        "(distinct work_dirs) and any of them accepts, runs, or "
+        "answers for any job; a replica that dies mid-job is "
+        "fenced and its job finishes on a survivor with resume "
+        "semantics (docs/serving.md \"Serving fleet\")",
+    )
+    parser.add_argument(
+        "--replica-id",
+        default=None,
+        metavar="ID",
+        help="stable fleet identity for this replica (default: "
+        "$REPIC_TPU_REPLICA_ID, else a pid-derived id).  Restarting "
+        "under the SAME id reclaims the replica's journaled jobs "
+        "and clears its stale fence",
+    )
+    parser.add_argument(
+        "--heartbeat-interval",
+        type=float,
+        default=2.0,
+        metavar="S",
+        help="fleet heartbeat renewal period (default 2.0; fleet "
+        "mode only)",
+    )
+    parser.add_argument(
+        "--replica-timeout",
+        type=float,
+        default=10.0,
+        metavar="S",
+        help="heartbeat age past which peers may fence this replica "
+        "and steal its job leases (default 10.0; must exceed the "
+        "heartbeat interval)",
+    )
+    parser.add_argument(
         "--slo-target",
         action="append",
         default=None,
@@ -103,27 +143,40 @@ def main(args):
         slo_targets = parse_slo_targets(args.slo_target)
     except ValueError as e:
         raise SystemExit(f"repic-tpu serve: {e}") from e
-    daemon = ConsensusDaemon(
-        args.work_dir,
-        port=args.port,
-        queue_limit=args.queue_limit,
-        default_deadline_s=args.default_deadline,
-        drain_grace_s=args.drain_grace,
-        breaker_threshold=args.breaker_threshold,
-        breaker_cooldown_s=args.breaker_cooldown,
-        warmup=not args.no_warmup,
-        slo_targets=slo_targets,
-    )
+    try:
+        daemon = ConsensusDaemon(
+            args.work_dir,
+            port=args.port,
+            queue_limit=args.queue_limit,
+            default_deadline_s=args.default_deadline,
+            drain_grace_s=args.drain_grace,
+            breaker_threshold=args.breaker_threshold,
+            breaker_cooldown_s=args.breaker_cooldown,
+            warmup=not args.no_warmup,
+            slo_targets=slo_targets,
+            fleet_dir=args.fleet_dir,
+            replica_id=args.replica_id,
+            heartbeat_interval_s=args.heartbeat_interval,
+            replica_timeout_s=args.replica_timeout,
+        )
+    except ValueError as e:
+        raise SystemExit(f"repic-tpu serve: {e}") from e
     try:
         daemon.start()
     except OSError as e:
         raise SystemExit(
             f"repic-tpu serve: cannot bind port {args.port}: {e}"
         ) from e
+    fleet_note = (
+        f" [fleet {daemon.fleet.fleet_dir} "
+        f"replica {daemon.fleet.replica}]"
+        if daemon.fleet is not None
+        else ""
+    )
     print(
         f"serve: http://127.0.0.1:{daemon.server.port} "
         "(POST /v1/jobs; /metrics /status /healthz/ready) "
-        f"[work_dir {daemon.work_dir}]",
+        f"[work_dir {daemon.work_dir}]{fleet_note}",
         file=sys.stderr,
     )
     daemon.install_signal_handlers()

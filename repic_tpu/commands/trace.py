@@ -68,7 +68,11 @@ def _resolve_dir(run_dir: str, job_id: str | None) -> str:
 
 
 def _list_jobs(run_dir: str) -> list[str]:
-    """Serve-work-dir fallback: job ids that carry a trace artifact."""
+    """Serve-work-dir fallback: job ids that carry a trace artifact
+    (the plain ``_trace.jsonl`` or any fleet-replica
+    ``_trace.<replica>.jsonl`` — a failed-over job has only the
+    latter)."""
+    from repic_tpu.runtime.journal import host_artifact_paths
     from repic_tpu.telemetry.trace import TRACE_NAME
 
     jobs_dir = os.path.join(run_dir, "jobs")
@@ -77,7 +81,7 @@ def _list_jobs(run_dir: str) -> list[str]:
     return sorted(
         j
         for j in os.listdir(jobs_dir)
-        if os.path.exists(os.path.join(jobs_dir, j, TRACE_NAME))
+        if host_artifact_paths(os.path.join(jobs_dir, j), TRACE_NAME)
     )
 
 

@@ -91,7 +91,12 @@ class ConsensusOptions:
     def from_dict(cls, data: dict) -> "ConsensusOptions":
         """Build from an untrusted request payload — unknown keys are
         a 400, not a silent ignore (a typo'd option must not quietly
-        run with defaults)."""
+        run with defaults), and every field is type- and
+        range-checked HERE: a dataclass call swallows wrong-typed
+        values silently (``threshold=[1,2]`` would ride along until
+        it crashed the worker mid-chunk), and the serve contract is
+        that a malformed request can only ever cost the client a
+        400, never a 5xx or a worker."""
         if not isinstance(data, dict):
             raise ValueError("options must be a JSON object")
         known = {f.name for f in fields(cls)}
@@ -99,6 +104,52 @@ class ConsensusOptions:
         if unknown:
             raise ValueError(
                 f"unknown option(s) {unknown}; known: {sorted(known)}"
+            )
+        import math
+
+        def _num(name, lo, hi, integer=False, optional=False):
+            if name not in data:
+                return
+            v = data[name]
+            if optional and v is None:
+                return
+            # bool is an int subclass: reject it explicitly, or
+            # `"strict": true` typo'd into a numeric field slips by
+            bad_type = isinstance(v, bool) or not isinstance(
+                v, int if integer else (int, float)
+            )
+            if bad_type or not math.isfinite(v) or not (
+                lo <= v <= hi
+            ):
+                kind = "an integer" if integer else "a number"
+                raise ValueError(
+                    f"option {name!r} must be {kind} in "
+                    f"[{lo}, {hi}], got {v!r}"
+                )
+
+        def _flag(name, optional=False):
+            if name not in data:
+                return
+            v = data[name]
+            if optional and v is None:
+                return
+            if not isinstance(v, bool):
+                raise ValueError(
+                    f"option {name!r} must be a boolean, got {v!r}"
+                )
+
+        _num("threshold", 1e-6, 1.0)
+        _num("max_neighbors", 1, 4096, integer=True)
+        _num("num_particles", 1, 10**7, integer=True, optional=True)
+        _num("max_retries", 0, 100, integer=True, optional=True)
+        _flag("use_mesh")
+        _flag("use_pallas")
+        _flag("strict")
+        _flag("spatial", optional=True)
+        if "solver" in data and not isinstance(data["solver"], str):
+            raise ValueError(
+                f"option 'solver' must be a string, got "
+                f"{data['solver']!r}"
             )
         return cls(**data)
 

@@ -96,3 +96,37 @@ def try_claim(path: str, payload: str) -> bool:
         f.flush()
         os.fsync(f.fileno())
     return True
+
+
+def commit_once(path: str, payload: str) -> bool:
+    """Create-once commit of a COMPLETE ``path``; False if it exists.
+
+    :func:`try_claim` creates the file first and writes the payload
+    after, so a crash between the two leaves an empty claim — fine
+    for fence tokens (existence is the whole message), wrong for
+    records whose CONTENT is the commit (the serve fleet's per-job
+    completion token, which carries the terminal state every replica
+    trusts).  Here the payload lands in a same-directory temp file
+    (flushed + fsynced) and is published with ``os.link``, which
+    fails with ``EEXIST`` if another committer won: creation stays
+    the linearization point, but the winner's file is complete by
+    construction — a fenced straggler racing a survivor can never
+    publish a torn token, and exactly one of them publishes at all.
+    """
+    import uuid
+
+    # pid alone is not unique enough: two THREADS of one process
+    # racing the same token would truncate each other's temp file
+    tmp = f"{path}.tmp{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    with open(tmp, "w") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    try:
+        os.link(tmp, path)
+        return True
+    except FileExistsError:
+        return False
+    finally:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)

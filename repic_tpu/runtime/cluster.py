@@ -37,6 +37,14 @@ single-writer; readers merge on read with last-writer-wins
 processing during a liveness flap is therefore benign: outputs are
 atomic and content-identical, journals merge cleanly.
 
+This module has a second consumer beyond multi-host consensus runs:
+the serving fleet (:mod:`repic_tpu.serve.fleet`) reuses the
+heartbeat/fence/liveness machinery verbatim as its replica-membership
+layer — a :class:`ClusterContext` whose coordination directory is the
+fleet directory — while layering its own per-JOB leases and
+exactly-once completion tokens on top (job granularity instead of
+micrograph-shard granularity).
+
 Deterministic failure testing uses three fault sites
 (:mod:`repic_tpu.runtime.faults`): ``host_crash`` (process dies via
 ``os._exit`` at a chunk boundary — no cleanup, the real thing),

@@ -53,6 +53,21 @@ site              raised at the matching call site
                   close, no drain).  Keys: ``accept:<job>``,
                   ``run:<job>``, ``run:<job>:chunk:<i>``,
                   ``finish:<job>``
+``replica_crash`` no exception — polled by
+                  ``serve.fleet.crash_point``, which terminates
+                  the process with ``os._exit(FLEET_CRASH_EXIT_
+                  CODE)``: an abrupt loss of one fleet replica
+                  mid-job (no lease release, no heartbeat stop,
+                  no journal close).  Keys:
+                  ``<replica>:lease:<job>``, ``<replica>:run:
+                  <job>``, ``<replica>:chunk:<job>:<i>``,
+                  ``<replica>:emit:<job>``
+``lease_steal``   no exception — polled in the fleet's dead-replica
+                  takeover (``serve.fleet.FleetMember.harvest``); a
+                  firing makes the fence claim report a lost race
+                  (as if another survivor fenced the dead replica
+                  first), deterministically exercising the
+                  "someone else owns this takeover" branch
 ================= ==================================================
 
 Injection is purely count-based (no randomness, no clocks): a
@@ -93,6 +108,8 @@ KNOWN_SITES = (
     "slow_client",
     "deadline_exceeded",
     "server_crash",
+    "replica_crash",
+    "lease_steal",
 )
 
 

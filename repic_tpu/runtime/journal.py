@@ -361,7 +361,7 @@ def merged_latest(out_dir: str) -> dict[str, dict]:
 
 
 class MergedJournalReader:
-    """Incremental :func:`merged_latest` for pollers.
+    """Incremental merge-on-read for pollers.
 
     The cluster orphan harvest re-reads the merged view every few
     hundred milliseconds while waiting out a heartbeat timeout; on a
@@ -369,27 +369,49 @@ class MergedJournalReader:
     NFS).  This reader re-parses only the files whose size changed
     since the previous call — journals are append-only, so size is a
     sufficient change signal — and re-sorts the (cheap) concatenation.
+
+    ``base_name`` selects which per-host artifact family is merged
+    (:func:`host_artifact_paths` discovery): the run journal by
+    default, or the serve fleet's per-replica request journals
+    (``_serve_journal.<replica>.jsonl``), whose records are keyed by
+    ``job`` rather than ``name`` — those callers fold
+    :meth:`entries` themselves.
     """
 
-    def __init__(self, out_dir: str):
+    def __init__(self, out_dir: str, base_name: str = JOURNAL_NAME):
         self.out_dir = out_dir
+        self.base_name = base_name
         self._cache: dict[str, tuple[int, list[dict]]] = {}
+        #: bumped whenever any file is (re)parsed or dropped —
+        #: callers that FOLD the entries (the fleet job view) key
+        #: their own fold cache on this, so a tight poll loop over
+        #: unchanged journals costs only the size stats
+        self.version = 0
 
-    def latest(self) -> dict[str, dict]:
+    def entries(self) -> list[dict]:
+        """Every entry across the merged family, timestamp-sorted
+        (stable, so folding front-to-back is last-writer-wins)."""
         entries: list[dict] = []
-        for path in journal_paths(self.out_dir):
+        for _host, path in host_artifact_paths(
+            self.out_dir, self.base_name
+        ):
             try:
                 size = os.path.getsize(path)
             except OSError:
-                self._cache.pop(path, None)
+                if self._cache.pop(path, None) is not None:
+                    self.version += 1
                 continue
             cached = self._cache.get(path)
             if cached is None or cached[0] != size:
                 self._cache[path] = (size, _read_entries(path))
+                self.version += 1
             entries.extend(self._cache[path][1])
         entries.sort(key=lambda e: float(e.get("ts", 0.0)))
+        return entries
+
+    def latest(self) -> dict[str, dict]:
         latest: dict[str, dict] = {}
-        for entry in entries:
+        for entry in self.entries():
             if "name" in entry:
                 latest[entry["name"]] = entry
         return latest

@@ -31,9 +31,17 @@ defined by how it behaves when things go wrong:
   job, and in-flight jobs resume from their per-job run journal with
   completed micrographs skipped — zero accepted work lost.
 
-Deterministic failure testing uses four fault sites
+* **fleet mode** — N replicas over one durable shared job queue
+  (:mod:`repic_tpu.serve.fleet`): per-replica request journals
+  merged on read, per-job ``O_EXCL`` leases, heartbeat-driven
+  fencing with lease steal after a replica loss, and exactly-once
+  completion through a create-once token — any replica answers for
+  any job, and a job survives the death of the replica running it.
+
+Deterministic failure testing uses six fault sites
 (:mod:`repic_tpu.runtime.faults`): ``request_storm``,
-``slow_client``, ``deadline_exceeded``, ``server_crash``.
+``slow_client``, ``deadline_exceeded``, ``server_crash``,
+``replica_crash``, ``lease_steal``.
 
 Operator docs: docs/serving.md.
 """

@@ -68,15 +68,33 @@ _JOB_SECONDS = telemetry.histogram(
 )
 
 
+#: request-body hard cap: the whole submission document is a few
+#: hundred bytes of paths and knobs — anything near a megabyte is a
+#: client bug or an attack, and must cost a 400, not daemon memory
+MAX_BODY_BYTES = 1 << 20
+MAX_IDEMPOTENCY_KEY = 200
+MAX_IN_DIR = 4096
+MAX_BOX_SIZES = 64
+
+
 def validate_submission(body: bytes):
     """Parse + validate a POST /v1/jobs body.
 
-    Returns ``(request, options, deadline_s, bucket_hint)`` or
-    raises ``ValueError`` with a client-readable message (mapped to
-    400 — a malformed request is the client's bug, never a 5xx).
+    Returns ``(request, options, deadline_s, bucket_hint,
+    idempotency_key)`` or raises ``ValueError`` with a
+    client-readable message (mapped to 400 — a malformed request is
+    the client's bug, never a 5xx and NEVER a worker crash: the
+    fuzz suite in tests/test_serve_fuzz.py holds this function to
+    "ValueError or a valid tuple, nothing else").
     """
+    import math
+
     from repic_tpu.pipeline.engine import ConsensusOptions
 
+    if len(body) > MAX_BODY_BYTES:
+        raise ValueError(
+            f"request body exceeds {MAX_BODY_BYTES} bytes"
+        )
     try:
         data = json.loads(body.decode("utf-8") or "{}")
     except (ValueError, UnicodeDecodeError) as e:
@@ -84,9 +102,10 @@ def validate_submission(body: bytes):
     if not isinstance(data, dict):
         raise ValueError("request body must be a JSON object")
     known = {
-        "in_dir", "box_size", "options", "deadline_s", "bucket_hint"
+        "in_dir", "box_size", "options", "deadline_s",
+        "bucket_hint", "idempotency_key",
     }
-    unknown = sorted(set(data) - known)
+    unknown = sorted(str(k)[:80] for k in set(data) - known)
     if unknown:
         raise ValueError(
             f"unknown field(s) {unknown}; known: {sorted(known)}"
@@ -94,33 +113,70 @@ def validate_submission(body: bytes):
     in_dir = data.get("in_dir")
     if not isinstance(in_dir, str) or not in_dir:
         raise ValueError("in_dir (string) is required")
+    if len(in_dir) > MAX_IN_DIR:
+        raise ValueError(f"in_dir exceeds {MAX_IN_DIR} chars")
     if not os.path.isdir(in_dir):
         raise ValueError(f"in_dir {in_dir!r} is not a directory")
     box_size = data.get("box_size")
     sizes = (
         box_size if isinstance(box_size, list) else [box_size]
     )
+    if len(sizes) > MAX_BOX_SIZES:
+        raise ValueError(
+            f"box_size lists more than {MAX_BOX_SIZES} pickers"
+        )
     if not sizes or not all(
-        isinstance(b, (int, float)) and b > 0 for b in sizes
+        isinstance(b, (int, float))
+        and not isinstance(b, bool)
+        and math.isfinite(b)
+        and 0 < b <= 1e6
+        for b in sizes
     ):
-        raise ValueError("box_size must be a positive number "
+        raise ValueError("box_size must be a positive finite number "
                          "(or a per-picker list of them)")
-    options = ConsensusOptions.from_dict(data.get("options") or {})
+    # None means "defaults", but a falsy WRONG type ([], 0, false,
+    # "") must still be a 400 — `or {}` would silently accept it
+    opts_raw = data.get("options")
+    if opts_raw is None:
+        opts_raw = {}
+    options = ConsensusOptions.from_dict(opts_raw)
     deadline_s = data.get("deadline_s")
     if deadline_s is not None:
-        if not isinstance(deadline_s, (int, float)) or deadline_s <= 0:
-            raise ValueError("deadline_s must be a positive number")
+        if (
+            not isinstance(deadline_s, (int, float))
+            or isinstance(deadline_s, bool)
+            or not math.isfinite(deadline_s)
+            or deadline_s <= 0
+        ):
+            raise ValueError(
+                "deadline_s must be a positive finite number"
+            )
         deadline_s = float(deadline_s)
     bucket_hint = data.get("bucket_hint")
     if bucket_hint is not None:
-        if not isinstance(bucket_hint, int) or bucket_hint < 1:
+        if (
+            not isinstance(bucket_hint, int)
+            or isinstance(bucket_hint, bool)
+            or not 1 <= bucket_hint <= 10**7
+        ):
             raise ValueError("bucket_hint must be a positive int")
+    idempotency_key = data.get("idempotency_key")
+    if idempotency_key is not None:
+        if (
+            not isinstance(idempotency_key, str)
+            or not idempotency_key
+            or len(idempotency_key) > MAX_IDEMPOTENCY_KEY
+        ):
+            raise ValueError(
+                "idempotency_key must be a non-empty string of at "
+                f"most {MAX_IDEMPOTENCY_KEY} chars"
+            )
     request = {
         "in_dir": os.path.abspath(in_dir),
         "box_size": box_size,
-        "options": data.get("options") or {},
+        "options": opts_raw,
     }
-    return request, options, deadline_s, bucket_hint
+    return request, options, deadline_s, bucket_hint, idempotency_key
 
 
 class ServeServer(tlm_server.StatusServer):
@@ -168,17 +224,19 @@ class ServeServer(tlm_server.StatusServer):
     def _submit(self, handler, body: bytes):
         _REQUESTS.inc(route="jobs_submit")
         try:
-            request, options, deadline_s, hint = validate_submission(
-                body
-            )
+            (request, options, deadline_s, hint,
+             idempotency_key) = validate_submission(body)
         except ValueError as e:
             self._json(handler, 400, {"error": str(e)})
             return
         if deadline_s is None:
             deadline_s = self.daemon.default_deadline_s
         try:
-            job = self.daemon.queue.submit(
-                request, deadline_s=deadline_s, bucket_hint=hint
+            job, deduped = self.daemon.queue.submit_idempotent(
+                request,
+                deadline_s=deadline_s,
+                bucket_hint=hint,
+                idempotency_key=idempotency_key,
             )
         except AdmissionError as e:
             self._json(
@@ -190,6 +248,13 @@ class ServeServer(tlm_server.StatusServer):
             )
             return
         self.daemon.publish_status()
+        if deduped:
+            # a retry of an accepted request: same job, and a 200 —
+            # the 202 durability promise was already made once
+            self._json(
+                handler, 200, dict(job.doc(), deduped=True)
+            )
+            return
         self._json(handler, 202, job.doc())
 
     def _one_job(self, handler, method, job_id):
@@ -199,9 +264,9 @@ class ServeServer(tlm_server.StatusServer):
             self._json(handler, 404, {"error": f"no job {job_id}"})
         elif method == "DELETE":
             _REQUESTS.inc(route="jobs_cancel")
-            self.daemon.queue.cancel(job_id)
+            got = self.daemon.queue.cancel(job_id)
             self.daemon.publish_status()
-            self._json(handler, 202, job.doc())
+            self._json(handler, 202, (got or job).doc())
         elif method == "GET":
             _REQUESTS.inc(route="jobs_get")
             self._json(handler, 200, job.doc())
@@ -270,6 +335,10 @@ class ConsensusDaemon:
         breaker_cooldown_s: float = 30.0,
         warmup: bool = True,
         slo_targets: dict | None = None,
+        fleet_dir: str | None = None,
+        replica_id: str | None = None,
+        heartbeat_interval_s: float = 2.0,
+        replica_timeout_s: float = 10.0,
         clock=time.time,
     ):
         self.work_dir = os.path.abspath(work_dir)
@@ -281,17 +350,40 @@ class ConsensusDaemon:
         # --slo-target objectives it still reports p50/p95/p99)
         self.slo = tlm_server.SLOTracker(objectives=slo_targets)
         os.makedirs(self.work_dir, exist_ok=True)
-        self.journal = ServeJournal(self.work_dir)
-        self.queue = JobQueue(
-            queue_limit,
-            self.journal,
-            CircuitBreaker(
-                threshold=breaker_threshold,
-                cooldown_s=breaker_cooldown_s,
-                clock=clock,
-            ),
+        breaker = CircuitBreaker(
+            threshold=breaker_threshold,
+            cooldown_s=breaker_cooldown_s,
             clock=clock,
         )
+        self.fleet = None
+        if fleet_dir is not None:
+            # fleet mode (docs/serving.md "Serving fleet"): shared
+            # durable queue + jobs/ in FLEET_DIR; this replica keeps
+            # its own work_dir for the discovery file only
+            from repic_tpu.serve.fleet import FleetMember, FleetQueue
+
+            self.fleet = FleetMember(
+                fleet_dir,
+                replica_id,
+                heartbeat_interval_s=heartbeat_interval_s,
+                replica_timeout_s=replica_timeout_s,
+                clock=clock,
+            )
+            self.journal = ServeJournal(
+                self.fleet.fleet_dir, replica=self.fleet.replica
+            )
+            self.queue = FleetQueue(
+                queue_limit,
+                self.journal,
+                self.fleet,
+                breaker,
+                clock=clock,
+            )
+        else:
+            self.journal = ServeJournal(self.work_dir)
+            self.queue = JobQueue(
+                queue_limit, self.journal, breaker, clock=clock
+            )
         self.server = ServeServer(self, port, host)
         self._stop = threading.Event()
         self._drain_deadline: float | None = None
@@ -300,10 +392,21 @@ class ConsensusDaemon:
     # -- lifecycle ----------------------------------------------------
 
     def job_dir(self, job_id: str) -> str:
-        return os.path.join(self.work_dir, "jobs", job_id)
+        root = (
+            self.fleet.fleet_dir
+            if self.fleet is not None
+            else self.work_dir
+        )
+        return os.path.join(root, "jobs", job_id)
 
     def start(self) -> "ConsensusDaemon":
-        recovered = self.journal.recover()
+        if self.fleet is not None:
+            # membership first: the heartbeat must be fresh (and any
+            # stale self-fence cleared) before peers see our journal
+            self.fleet.start()
+            recovered = self.queue.recover_own()
+        else:
+            recovered = self.journal.recover()
         self.server.start()
         tlm_server.set_slo_tracker(self.slo)
         self.journal.record_event(
@@ -312,8 +415,9 @@ class ConsensusDaemon:
             port=self.server.port,
             recovered=[j.id for j in recovered],
         )
-        for job in recovered:
-            self.queue.adopt(job)
+        if self.fleet is None:
+            for job in recovered:
+                self.queue.adopt(job)
         if recovered:
             _log.info(
                 f"recovered {len(recovered)} journaled job(s) "
@@ -321,18 +425,19 @@ class ConsensusDaemon:
             )
         # discovery file: ephemeral-port consumers (CI, operators)
         # read the bound port from here instead of parsing stderr
+        info = {
+            "pid": os.getpid(),
+            "host": self.server.host,
+            "port": self.server.port,
+            "started_ts": self._clock(),
+        }
+        if self.fleet is not None:
+            info["replica"] = self.fleet.replica
+            info["fleet_dir"] = self.fleet.fleet_dir
         with atomic_write(
             os.path.join(self.work_dir, SERVE_INFO_NAME)
         ) as f:
-            json.dump(
-                {
-                    "pid": os.getpid(),
-                    "host": self.server.host,
-                    "port": self.server.port,
-                    "started_ts": self._clock(),
-                },
-                f,
-            )
+            json.dump(info, f)
         self.publish_status()
         self._worker = threading.Thread(
             target=self._worker_loop,
@@ -375,6 +480,11 @@ class ConsensusDaemon:
         if self._worker is not None:
             self._worker.join(timeout=self.drain_grace_s + 30.0)
         self.journal.record_event("drain_complete")
+        if self.fleet is not None:
+            # clean stop: the final heartbeat records `stopped`, so
+            # peers may immediately reassign anything we left —
+            # though a clean drain leaves no leases behind at all
+            self.fleet.stop(clean=True)
         if tlm_server.get_slo_tracker() is self.slo:
             tlm_server.set_slo_tracker(None)
         self.server.stop()
@@ -388,13 +498,19 @@ class ConsensusDaemon:
         by_state: dict[str, int] = {}
         for j in self.queue.jobs():
             by_state[j.state] = by_state.get(j.state, 0) + 1
-        tlm_server.set_status(
+        fields = dict(
             service="serve",
             work_dir=self.work_dir,
             jobs=by_state,
             draining=self.queue.draining,
-            breaker=self.queue.breaker.state,
+            # full breaker visibility (state + consecutive-failure
+            # count + cooldown) — a tripped breaker must be readable
+            # off /status, not inferred from 503s
+            breaker=self.queue.breaker.describe(),
         )
+        if self.fleet is not None:
+            fields["fleet"] = self.queue.fleet_status()
+        tlm_server.set_status(**fields)
 
     # -- worker -------------------------------------------------------
 
@@ -454,7 +570,19 @@ class ConsensusDaemon:
                 job.cancel_reason = (
                     "deadline exceeded (injected fault)"
                 )
+            elif self.fleet is not None and self.fleet.is_fenced():
+                # a survivor fenced this replica and reassigned the
+                # job: stop at the chunk boundary WITHOUT a terminal
+                # record — the new owner's commit is the only one
+                job.cancel_reason = "fenced by a peer replica"
             elif job.cancel_requested:
+                job.cancel_reason = "cancelled by client"
+            elif self.fleet is not None and (
+                self.queue.cancel_requested_remote(job.id)
+            ):
+                # DELETE landed on another replica: the cancel rides
+                # the merged fleet journal to whoever runs the job
+                job.cancel_requested = True
                 job.cancel_reason = "cancelled by client"
             elif (
                 job.deadline_ts is not None
@@ -518,9 +646,15 @@ class ConsensusDaemon:
         )
         tlm_server.observe_slo("queue_wait", queue_wait)
         os.makedirs(out_dir, exist_ok=True)
+        # fleet mode: per-replica trace artifact (_trace.<replica>.
+        # jsonl) under the SAME trace id minted at accept — a job
+        # that fails over writes two files that merge into one
+        # waterfall spanning both replicas (`repic-tpu trace`)
+        replica = self.fleet.replica if self.fleet else None
         tctx = tlm_trace.start(
             out_dir,
             trace_id=job.trace_id,
+            host=replica,  # root record carries it as "host"
             kind="serve",
             job=job.id,
             accepted_ts=round(job.accepted_ts, 6),
@@ -549,6 +683,11 @@ class ConsensusDaemon:
         from repic_tpu.utils import box_io
 
         crash_point(f"run:{job.id}")
+        replica = self.fleet.replica if self.fleet else None
+        if self.fleet is not None:
+            from repic_tpu.serve import fleet as fleet_mod
+
+            fleet_mod.crash_point(replica, f"run:{job.id}")
         t0 = self._clock()
         # a job that aged out while queued never touches the device
         if (
@@ -591,12 +730,22 @@ class ConsensusDaemon:
             # resume semantics give crash recovery its zero-loss
             # guarantee: a re-run of a journaled in-flight job skips
             # every micrograph whose outcome + artifact survived
+            # fleet mode opens the run journal in CLUSTER shape:
+            # each attempt appends to its own _journal.<replica>.
+            # jsonl and resumes from the MERGED view, so a takeover
+            # re-run skips the dead replica's completed micrographs
+            # without sharing a writer with a wedged straggler
             journal = run_journal = RunJournal.open(
-                out_dir, run_config, resume=True
+                out_dir,
+                run_config,
+                resume=True,
+                host=replica,
+                cluster=replica is not None,
             )
             rt = telemetry.start_run(
                 out_dir,
                 run_id=f"serve-{job.id}",
+                host=replica,
             )
             already = set()
             if journal.resumed:
@@ -762,6 +911,10 @@ class ConsensusDaemon:
                         )
                         telemetry.flush_run(rt)
                     crash_point(f"run:{job.id}:chunk:{i}")
+                    if self.fleet is not None:
+                        fleet_mod.crash_point(
+                            replica, f"chunk:{job.id}:{i}"
+                        )
                     t_mark = time.time()
                     comp_mark = tlm_probes.compile_seconds()
                     hits_mark = hits_now
@@ -801,6 +954,12 @@ class ConsensusDaemon:
             # resumes instead of redoing
             reason = job.cancel_reason or "cancelled"
             job.reason = reason
+            if reason.startswith("fenced"):
+                # a survivor owns the job now: no terminal record,
+                # no re-queue — just stop (the fence winner's commit
+                # is the job's single completion)
+                self.queue.abandon(job)
+                return bucket
             if reason.startswith("deadline"):
                 state = JOB_DEADLINE_EXCEEDED
             elif reason.startswith("draining"):

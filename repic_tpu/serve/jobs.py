@@ -71,6 +71,14 @@ _BREAKER_TRIPS = telemetry.counter(
     "repic_serve_breaker_trips_total",
     "circuit breaker open transitions",
 )
+_BREAKER_FAILURES = telemetry.gauge(
+    "repic_serve_breaker_failures",
+    "consecutive job failures counted toward the breaker threshold",
+)
+_DEDUPED = telemetry.counter(
+    "repic_serve_deduped_total",
+    "submissions answered from an existing job via idempotency key",
+)
 # One admission-outcome surface for dashboards: every submission
 # lands exactly once, labeled by outcome (accepted/rejected), the
 # cause, and the HTTP code the client saw — the scrape-side join of
@@ -120,6 +128,8 @@ class Job:
     accepted_ts: float
     state: str = JOB_QUEUED
     trace_id: str | None = None    # request-scoped tracing key
+    idempotency_key: str | None = None  # client retry dedupe handle
+    replica: str | None = None     # fleet: replica that ran/runs it
     deadline_ts: float | None = None
     bucket_hint: int | None = None
     started_ts: float | None = None
@@ -146,6 +156,10 @@ class Job:
         }
         if self.trace_id is not None:
             out["trace_id"] = self.trace_id
+        if self.idempotency_key is not None:
+            out["idempotency_key"] = self.idempotency_key
+        if self.replica is not None:
+            out["replica"] = self.replica
         if self.deadline_ts is not None:
             out["deadline_ts"] = self.deadline_ts
         if self.progress:
@@ -171,22 +185,43 @@ class ServeJournal:
     recording), flushed per record so a crash loses at most a torn
     trailing line — which :func:`recover` tolerates the same way the
     run journal does.
+
+    Fleet mode (``replica=...``): each replica appends to its OWN
+    ``_serve_journal.<replica>.jsonl`` in the shared fleet directory
+    — the same single-writer-per-file / merge-on-read scheme the
+    cluster run journal uses — and every record carries a
+    ``replica`` field, so the merged view attributes each state
+    transition to the replica that made it.
     """
 
-    def __init__(self, work_dir: str):
+    def __init__(self, work_dir: str, replica: str | None = None):
+        from repic_tpu.runtime.journal import sanitize_host_id
+
         self.work_dir = work_dir
-        self.path = os.path.join(work_dir, SERVE_JOURNAL_NAME)
+        self.replica = (
+            sanitize_host_id(replica) if replica else None
+        )
+        if self.replica is None:
+            name = SERVE_JOURNAL_NAME
+        else:
+            stem, ext = os.path.splitext(SERVE_JOURNAL_NAME)
+            name = f"{stem}.{self.replica}{ext}"
+        self.path = os.path.join(work_dir, name)
         self._fh = None
         self._lock = threading.Lock()
 
     def record(self, job_id: str, state: str, **fields) -> dict:
         entry = {"job": job_id, "state": state, "ts": time.time()}
+        if self.replica:
+            entry["replica"] = self.replica
         entry.update(fields)
         self._append(entry)
         return entry
 
     def record_event(self, event: str, **fields) -> dict:
         entry = {"event": event, "ts": time.time()}
+        if self.replica:
+            entry["replica"] = self.replica
         entry.update(fields)
         self._append(entry)
         return entry
@@ -247,6 +282,7 @@ class ServeJournal:
                 # the original accept's trace id survives the crash:
                 # the re-run's spans/segments join the same request
                 trace_id=first.get("trace"),
+                idempotency_key=first.get("idempotency_key"),
                 deadline_ts=first.get("deadline_ts"),
                 bucket_hint=first.get("bucket_hint"),
                 resumed=state == JOB_RUNNING,
@@ -286,6 +322,7 @@ class CircuitBreaker:
         self.failures = 0
         self.opened_ts: float | None = None
         _BREAKER_STATE.set(0)
+        _BREAKER_FAILURES.set(0)
 
     def _set_state(self, state: str) -> None:
         self.state = state
@@ -311,11 +348,13 @@ class CircuitBreaker:
     def record_success(self) -> None:
         with self._lock:
             self.failures = 0
+            _BREAKER_FAILURES.set(0)
             self._set_state(self.CLOSED)
 
     def record_failure(self) -> None:
         with self._lock:
             self.failures += 1
+            _BREAKER_FAILURES.set(self.failures)
             if (
                 self.state == self.HALF_OPEN
                 or self.failures >= self.threshold
@@ -324,6 +363,25 @@ class CircuitBreaker:
                     _BREAKER_TRIPS.inc()
                 self._set_state(self.OPEN)
                 self.opened_ts = self._clock()
+
+    def describe(self) -> dict:
+        """The /status view: state, consecutive failures, and — while
+        open — how long until the half-open probe window.  The same
+        numbers ride on /metrics (`repic_serve_breaker_state`,
+        `repic_serve_breaker_failures`), so a tripped breaker is
+        visible on both surfaces instead of silently eating jobs."""
+        with self._lock:
+            out = {
+                "state": self.state,
+                "consecutive_failures": self.failures,
+                "threshold": self.threshold,
+            }
+            if self.state == self.OPEN:
+                elapsed = self._clock() - (self.opened_ts or 0.0)
+                out["cooldown_remaining_s"] = round(
+                    max(self.cooldown_s - elapsed, 0.0), 3
+                )
+            return out
 
 
 class JobQueue:
@@ -372,6 +430,7 @@ class JobQueue:
         self._jobs: dict[str, Job] = {}
         self._pending: list[str] = []
         self._terminal: list[str] = []  # completion order (eviction)
+        self._idemp: dict[str, str] = {}  # idempotency key -> job id
         self._running: str | None = None
         self.draining = False
         # decayed average job wall time, the Retry-After estimate
@@ -385,8 +444,44 @@ class JobQueue:
         *,
         deadline_s: float | None = None,
         bucket_hint: int | None = None,
+        idempotency_key: str | None = None,
     ) -> Job:
         """Admit one request or raise :class:`AdmissionError`."""
+        return self.submit_idempotent(
+            request,
+            deadline_s=deadline_s,
+            bucket_hint=bucket_hint,
+            idempotency_key=idempotency_key,
+        )[0]
+
+    def _lookup_idempotent(self, key: str | None) -> Job | None:
+        if not key:
+            return None
+        with self._lock:
+            jid = self._idemp.get(key)
+            return self._jobs.get(jid) if jid else None
+
+    def submit_idempotent(
+        self,
+        request: dict,
+        *,
+        deadline_s: float | None = None,
+        bucket_hint: int | None = None,
+        idempotency_key: str | None = None,
+    ) -> tuple[Job, bool]:
+        """:meth:`submit`, returning ``(job, deduped)``.
+
+        A submission carrying an ``idempotency_key`` already bound to
+        a known job returns THAT job with ``deduped=True`` — nothing
+        journaled, no admission checks: a client retry of an accepted
+        request (lost 202, timeout, fleet failover to another
+        replica) must never create a second job, never be 429'd, and
+        must work even mid-drain.
+        """
+        existing = self._lookup_idempotent(idempotency_key)
+        if existing is not None:
+            _DEDUPED.inc()
+            return existing, True
         if self.draining:
             _REJECTED.inc(reason="draining")
             _ADMISSION.inc(
@@ -402,6 +497,13 @@ class JobQueue:
             )
             raise
         with self._lock:
+            # re-check under the creation lock: two concurrent
+            # retries with one key must still yield one job
+            if idempotency_key and idempotency_key in self._idemp:
+                job = self._jobs.get(self._idemp[idempotency_key])
+                if job is not None:
+                    _DEDUPED.inc()
+                    return job, True
             backlog = len(self._pending) + (
                 1 if self._running else 0
             )
@@ -415,8 +517,7 @@ class JobQueue:
                 raise AdmissionError(
                     429,
                     "queue_full",
-                    # every queued job ahead costs ~one average job
-                    self._avg_job_s * max(backlog, 1),
+                    self._retry_after_s(max(backlog, 1)),
                 )
             now = self._clock()
             job = Job(
@@ -426,6 +527,7 @@ class JobQueue:
                 # the trace id is minted AT ACCEPT: queue residency,
                 # execution, and emit all join back to this moment
                 trace_id=tlm_trace.new_trace_id(),
+                idempotency_key=idempotency_key,
                 deadline_ts=(
                     now + deadline_s
                     if deadline_s is not None
@@ -435,6 +537,11 @@ class JobQueue:
             )
             # journal BEFORE the queue insert becomes visible: once
             # the caller sees 202 the job survives any crash
+            extra = (
+                {"idempotency_key": idempotency_key}
+                if idempotency_key
+                else {}
+            )
             self.journal.record(
                 job.id,
                 JOB_QUEUED,
@@ -442,9 +549,12 @@ class JobQueue:
                 deadline_ts=job.deadline_ts,
                 bucket_hint=bucket_hint,
                 trace=job.trace_id,
+                **extra,
             )
             self._jobs[job.id] = job
             self._pending.append(job.id)
+            if idempotency_key:
+                self._idemp[idempotency_key] = job.id
             _DEPTH.set(len(self._pending))
         _ADMITTED.inc()
         _ADMISSION.inc(
@@ -452,7 +562,13 @@ class JobQueue:
         )
         crash_point(f"accept:{job.id}")
         self._wake.set()
-        return job
+        return job, False
+
+    def _retry_after_s(self, backlog: int) -> float:
+        """429 backoff estimate: every queued job ahead costs ~one
+        decayed-average job (the fleet queue overrides this with the
+        fleet-wide depth spread over live replicas)."""
+        return self._avg_job_s * max(backlog, 1)
 
     def adopt(self, job: Job) -> None:
         """Re-queue a recovered job (daemon restart) — no admission
@@ -461,6 +577,8 @@ class JobQueue:
         with self._lock:
             self._jobs[job.id] = job
             self._pending.append(job.id)
+            if job.idempotency_key:
+                self._idemp[job.idempotency_key] = job.id
             _DEPTH.set(len(self._pending))
         self._wake.set()
 
@@ -524,7 +642,12 @@ class JobQueue:
         """Bound in-memory job history (call with the lock held)."""
         self._terminal.append(job_id)
         while len(self._terminal) > self.MAX_TERMINAL:
-            self._jobs.pop(self._terminal.pop(0), None)
+            evicted = self._jobs.pop(self._terminal.pop(0), None)
+            if evicted is not None and evicted.idempotency_key:
+                # a dangling index entry would alias a NEW submission
+                # onto the evicted id; dedupe history is bounded by
+                # the same cap as the job map
+                self._idemp.pop(evicted.idempotency_key, None)
 
     def mark_running(self, job: Job) -> None:
         # job.state is lock-guarded shared state (finish/cancel and

@@ -238,6 +238,17 @@ def finish_run(rt: RunTelemetry | None) -> None:
         rt.finished = True
         if rt.log is None:
             return
-        events.set_current_log(rt.prev)
+        # restore only if WE are still the installed log: two runs
+        # overlapping in one process (fleet replicas under test)
+        # finish out of order, and blindly restoring `prev` would
+        # either clobber the other run's live log or resurrect a
+        # closed one as the process-wide default
+        if events.current_log() is rt.log:
+            prev = rt.prev
+            if prev is not None and getattr(
+                prev, "_fh", None
+            ) is None:
+                prev = None  # outer run already finished (overlap)
+            events.set_current_log(prev)
         rt.log.close()
         _write_sinks(rt, sample_memory=True)

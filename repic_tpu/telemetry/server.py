@@ -60,6 +60,9 @@ _HTTP_SECONDS = _metrics.histogram(
     "status/serve endpoint latency (by route)",
 )
 
+#: hard cap on any request body this server will buffer (413 above)
+MAX_REQUEST_BODY = 4 << 20
+
 
 # -- SLO tracking ------------------------------------------------------
 
@@ -330,7 +333,26 @@ class StatusServer:
                     )
 
             def _dispatch_inner(self, method: str, path: str):
-                length = int(self.headers.get("Content-Length") or 0)
+                try:
+                    length = int(
+                        self.headers.get("Content-Length") or 0
+                    )
+                except ValueError:
+                    self._send(
+                        400, "text/plain; charset=utf-8",
+                        "bad Content-Length\n",
+                    )
+                    return
+                if not 0 <= length <= MAX_REQUEST_BODY:
+                    # refuse to buffer an absurd body — a NEGATIVE
+                    # length would make read(-1) buffer until the
+                    # client closes, the exact abuse this cap stops;
+                    # the serve layer re-checks its own tighter cap
+                    self._send(
+                        413, "text/plain; charset=utf-8",
+                        "request body too large\n",
+                    )
+                    return
                 body = self.rfile.read(length) if length else b""
                 if server.handle_request(self, method, path, body):
                     return
@@ -456,6 +478,33 @@ class StatusServer:
         doc["ts"] = time.time()
         if _SLO is not None:
             doc["slo"] = _SLO.summary()
+        fleet = doc.get("fleet")
+        if isinstance(fleet, dict) and fleet.get("fleet_dir"):
+            # the pushed snapshot ages between publish_status calls;
+            # replica liveness is recomputed per scrape so a dead
+            # peer shows suspect as soon as its heartbeat ages out
+            try:
+                from repic_tpu.runtime.cluster import read_liveness
+
+                view = read_liveness(
+                    fleet["fleet_dir"],
+                    float(fleet.get("replica_timeout_s", 10.0)),
+                )
+                doc["fleet"] = dict(
+                    fleet,
+                    replicas={
+                        r: {
+                            "rung": s.rung,
+                            "age_s": (
+                                None if s.age_s is None
+                                else round(s.age_s, 3)
+                            ),
+                        }
+                        for r, s in view.items()
+                    },
+                )
+            except Exception:  # noqa: BLE001 - scrape never raises
+                pass
         cluster = doc.get("cluster")
         if isinstance(cluster, dict) and cluster.get(
             "coordination_dir"

@@ -1,0 +1,673 @@
+"""Serving-fleet tests: leases, fencing, steal, exactly-once commit.
+
+The ISSUE 11 surface at the unit/in-process level (the subprocess
+SIGKILL chaos gate is tests/test_fleet_chaos.py): per-job ``O_EXCL``
+leases are exclusive across replicas; a dead replica is fenced and
+its leases stolen (with the ``lease_steal`` fault exercising the
+lost-race branch); terminal states commit exactly once through the
+completion token and a fenced replica cannot commit at all;
+idempotency keys dedupe retries fleet-wide; any replica answers
+GET/DELETE for any job; the 429 backoff is fleet-aware.
+"""
+
+import json
+import os
+
+import pytest
+
+from repic_tpu.runtime import faults
+from repic_tpu.runtime.atomic import commit_once
+from repic_tpu.runtime.cluster import fence_path
+from repic_tpu.runtime.journal import _read_entries
+from repic_tpu.serve.fleet import (
+    FleetMember,
+    FleetQueue,
+    done_path,
+    job_lease_path,
+    resolve_replica_id,
+)
+from repic_tpu.serve.jobs import (
+    JOB_FINISHED,
+    JOB_QUEUED,
+    JOB_RUNNING,
+    TERMINAL_STATES,
+    AdmissionError,
+    ServeJournal,
+)
+
+REQ = {"in_dir": "/tmp", "box_size": 180, "options": {}}
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _member(fleet, rid, clk, timeout=1.0):
+    m = FleetMember(
+        str(fleet),
+        rid,
+        heartbeat_interval_s=0.2,
+        replica_timeout_s=timeout,
+        clock=clk,
+    )
+    # no renewal thread in unit tests: heartbeats are explicit beats
+    # against the injectable clock, so liveness is deterministic
+    m.ctx.beat()
+    return m
+
+
+def _queue(fleet, member, limit=8, clk=None):
+    return FleetQueue(
+        limit,
+        ServeJournal(str(fleet), replica=member.replica),
+        member,
+        clock=clk or member._clock,
+    )
+
+
+def _all_state_records(fleet, job_id):
+    import glob
+
+    out = []
+    for path in sorted(
+        glob.glob(os.path.join(str(fleet), "_serve_journal*.jsonl"))
+    ):
+        out.extend(
+            e
+            for e in _read_entries(path)
+            if e.get("job") == job_id
+            and "state" in e
+            and "event" not in e
+        )
+    return out
+
+
+# -- primitives -------------------------------------------------------
+
+
+def test_commit_once_is_exclusive_and_complete(tmp_path):
+    path = str(tmp_path / "token.json")
+    assert commit_once(path, '{"winner": 1}') is True
+    assert commit_once(path, '{"winner": 2}') is False
+    with open(path) as f:
+        assert json.load(f) == {"winner": 1}
+    # no temp litter
+    assert os.listdir(tmp_path) == ["token.json"]
+
+
+def test_resolve_replica_id_env_and_sanitize(monkeypatch):
+    monkeypatch.setenv("REPIC_TPU_REPLICA_ID", "rack1/node 2")
+    assert resolve_replica_id() == "rack1_node_2"
+    monkeypatch.delenv("REPIC_TPU_REPLICA_ID")
+    # default is hostname+pid: pid alone collides across machines
+    # sharing one fleet dir
+    rid = resolve_replica_id()
+    assert rid.endswith(f"-{os.getpid()}")
+    from repic_tpu.runtime.journal import sanitize_host_id
+
+    assert rid == sanitize_host_id(rid)  # filename-safe as-is
+
+
+def test_job_lease_is_exclusive(tmp_path):
+    clk = Clock()
+    a = _member(tmp_path, "a", clk)
+    b = _member(tmp_path, "b", clk)
+    assert a.lease_job("job-x") is True
+    assert b.lease_job("job-x") is False
+    assert a.lease_info("job-x")["replica"] == "a"
+    # only the owner can release
+    b.release_lease("job-x")
+    assert a.lease_info("job-x") is not None
+    a.release_lease("job-x")
+    assert a.lease_info("job-x") is None
+
+
+def test_commit_terminal_exactly_once(tmp_path):
+    clk = Clock()
+    a = _member(tmp_path, "a", clk)
+    b = _member(tmp_path, "b", clk)
+    assert a.commit_terminal("job-x", JOB_FINISHED) is None
+    lost = b.commit_terminal("job-x", "failed")
+    assert lost is not None
+    assert lost["state"] == JOB_FINISHED
+    assert lost["replica"] == "a"
+
+
+def test_fenced_replica_cannot_commit(tmp_path):
+    clk = Clock()
+    a = _member(tmp_path, "a", clk)
+    b = _member(tmp_path, "b", clk)
+    # b fences a (the survivor path); a then wakes and tries to emit
+    clk.advance(5.0)
+    b.ctx.beat()
+    st = b.liveness()["a"]
+    assert st.rung == "suspect"
+    assert b._fence_replica("a", st) is True
+    res = a.commit_terminal("job-y", JOB_FINISHED)
+    assert res is not None  # commit refused
+    assert not os.path.exists(done_path(str(tmp_path), "job-y"))
+
+
+# -- harvest: fence + steal -------------------------------------------
+
+
+def _orphan_setup(tmp_path, clk):
+    """Replica a accepts+leases a job, then dies (heartbeat ages
+    out); returns (b, qb, job_id)."""
+    a = _member(tmp_path, "a", clk)
+    ja = ServeJournal(str(tmp_path), replica="a")
+    ja.record("job-orph", JOB_QUEUED, request=REQ, trace="t1")
+    ja.record("job-orph", JOB_RUNNING, trace="t1")
+    ja.close()
+    assert a.lease_job("job-orph")
+    clk.advance(5.0)  # a's heartbeat is now ancient
+    b = _member(tmp_path, "b", clk)
+    return b, _queue(tmp_path, b), "job-orph"
+
+
+def test_harvest_fences_dead_replica_and_steals_lease(tmp_path):
+    clk = Clock()
+    b, qb, jid = _orphan_setup(tmp_path, clk)
+    stolen = b.harvest(qb.fleet_view(), qb.journal)
+    assert stolen == [jid]
+    lease = b.lease_info(jid)
+    assert lease["replica"] == "b"
+    assert lease["epoch"] == 2
+    assert lease["stolen_from"] == "a"
+    assert os.path.exists(fence_path(str(tmp_path), "a"))
+    events = [
+        e.get("event")
+        for e in _read_entries(qb.journal.path)
+    ]
+    assert "replica_fenced" in events
+    assert "job_reassigned" in events
+    # the stolen job surfaces through the scheduler as a resumed run
+    job = qb.next_job(0.1)
+    assert job is not None and job.id == jid
+    assert job.resumed is True
+    assert job.trace_id == "t1"  # the accept's trace id survives
+
+
+@pytest.mark.faults
+def test_lease_steal_fault_loses_the_race(tmp_path):
+    clk = Clock()
+    b, qb, jid = _orphan_setup(tmp_path, clk)
+    with faults.fault_plan("lease_steal::1"):
+        assert b.harvest(qb.fleet_view(), qb.journal) == []
+        assert b.lease_info(jid)["replica"] == "a"
+        # plan spent: the next harvest round wins the takeover
+        assert b.harvest(qb.fleet_view(), qb.journal) == [jid]
+    assert b.lease_info(jid)["replica"] == "b"
+
+
+def test_harvest_leaves_live_replicas_alone(tmp_path):
+    clk = Clock()
+    a = _member(tmp_path, "a", clk)
+    ja = ServeJournal(str(tmp_path), replica="a")
+    ja.record("job-live", JOB_QUEUED, request=REQ)
+    ja.close()
+    assert a.lease_job("job-live")
+    b = _member(tmp_path, "b", clk)
+    qb = _queue(tmp_path, b)
+    a.ctx.beat()  # a is demonstrably alive
+    assert b.harvest(qb.fleet_view(), qb.journal) == []
+    assert b.lease_info("job-live")["replica"] == "a"
+
+
+# -- the fleet queue --------------------------------------------------
+
+
+def test_submit_claim_run_finish_exactly_once(tmp_path):
+    clk = Clock()
+    a = _member(tmp_path, "a", clk)
+    qa = _queue(tmp_path, a)
+    job = qa.submit(dict(REQ))
+    assert job.state == JOB_QUEUED
+    got = qa.next_job(0.1)
+    assert got is job
+    assert a.lease_info(job.id)["replica"] == "a"
+    qa.mark_running(job)
+    qa.finish(job, JOB_FINISHED, particles=7)
+    done = a.read_done(job.id)
+    assert done["state"] == JOB_FINISHED
+    assert a.lease_info(job.id) is None  # released after commit
+    records = _all_state_records(tmp_path, job.id)
+    terminal = [
+        r for r in records if r["state"] in TERMINAL_STATES
+    ]
+    assert len(terminal) == 1
+    assert terminal[0]["particles"] == 7
+
+
+def test_commit_lost_adopts_winner_state(tmp_path):
+    clk = Clock()
+    a = _member(tmp_path, "a", clk)
+    b = _member(tmp_path, "b", clk)
+    qa = _queue(tmp_path, a)
+    job = qa.submit(dict(REQ))
+    assert qa.next_job(0.1) is job
+    qa.mark_running(job)
+    # a survivor (b) already committed this job
+    assert b.commit_terminal(job.id, JOB_FINISHED) is None
+    qa.finish(job, "failed", error={"type": "X"})
+    assert job.state == JOB_FINISHED  # adopted, not overwritten
+    # the loser journaled NO terminal state record (only the
+    # commit_lost event) — the completion token is the authority,
+    # and every replica's view folds it in
+    terminal = [
+        r
+        for r in _all_state_records(tmp_path, job.id)
+        if r["state"] in TERMINAL_STATES
+    ]
+    assert terminal == []
+    events = [
+        e.get("event") for e in _read_entries(qa.journal.path)
+    ]
+    assert "commit_lost" in events
+    assert qa.get(job.id).state == JOB_FINISHED
+    b_view = _queue(tmp_path, b)
+    assert b_view.get(job.id).state == JOB_FINISHED
+
+
+def test_any_replica_answers_get(tmp_path):
+    clk = Clock()
+    a = _member(tmp_path, "a", clk)
+    qa = _queue(tmp_path, a)
+    job = qa.submit(dict(REQ), deadline_s=60.0)
+    b = _member(tmp_path, "b", clk)
+    qb = _queue(tmp_path, b)
+    doc = qb.get(job.id)
+    assert doc is not None
+    assert doc.state == JOB_QUEUED
+    assert doc.request == REQ
+    assert doc.trace_id == job.trace_id
+    assert {j.id for j in qb.jobs()} >= {job.id}
+    # terminal outcome propagates too
+    assert qa.next_job(0.1) is job
+    qa.mark_running(job)
+    qa.finish(job, JOB_FINISHED, particles=3)
+    doc2 = qb.get(job.id)
+    assert doc2.state == JOB_FINISHED
+    assert doc2.result.get("particles") == 3
+
+
+def test_cancel_queued_job_from_another_replica(tmp_path):
+    clk = Clock()
+    a = _member(tmp_path, "a", clk)
+    qa = _queue(tmp_path, a)
+    job = qa.submit(dict(REQ))
+    b = _member(tmp_path, "b", clk)
+    qb = _queue(tmp_path, b)
+    got = qb.cancel(job.id)
+    assert got.state == "cancelled"
+    assert b.read_done(job.id)["state"] == "cancelled"
+    # the original replica sees the cancellation and never runs it
+    assert qa.next_job(0.05) is None
+    assert qa.get(job.id).state == "cancelled"
+    terminal = [
+        r
+        for r in _all_state_records(tmp_path, job.id)
+        if r["state"] in TERMINAL_STATES
+    ]
+    assert len(terminal) == 1
+
+
+def test_cancel_running_job_rides_the_journal(tmp_path):
+    clk = Clock()
+    a = _member(tmp_path, "a", clk)
+    qa = _queue(tmp_path, a)
+    job = qa.submit(dict(REQ))
+    assert qa.next_job(0.1) is job
+    qa.mark_running(job)
+    b = _member(tmp_path, "b", clk)
+    qb = _queue(tmp_path, b)
+    got = qb.cancel(job.id)
+    assert got.cancel_requested is True
+    # the runner's chunk-boundary poll sees the request
+    assert qa.cancel_requested_remote(job.id) is True
+
+
+def test_idempotent_submit_dedupes_across_replicas(tmp_path):
+    clk = Clock()
+    a = _member(tmp_path, "a", clk)
+    qa = _queue(tmp_path, a)
+    job, deduped = qa.submit_idempotent(
+        dict(REQ), idempotency_key="k-1"
+    )
+    assert deduped is False
+    again, deduped2 = qa.submit_idempotent(
+        dict(REQ), idempotency_key="k-1"
+    )
+    assert deduped2 is True and again.id == job.id
+    b = _member(tmp_path, "b", clk)
+    qb = _queue(tmp_path, b)
+    other, deduped3 = qb.submit_idempotent(
+        dict(REQ), idempotency_key="k-1"
+    )
+    assert deduped3 is True and other.id == job.id
+    fresh, deduped4 = qb.submit_idempotent(
+        dict(REQ), idempotency_key="k-2"
+    )
+    assert deduped4 is False and fresh.id != job.id
+
+
+@pytest.mark.faults
+def test_fleet_retry_after_spreads_over_live_replicas(tmp_path):
+    clk = Clock()
+    a = _member(tmp_path, "a", clk)
+    b = _member(tmp_path, "b", clk)
+    qa = _queue(tmp_path, a, limit=1)
+    qa._avg_job_s = 40.0
+    qa.submit(dict(REQ))
+    with pytest.raises(AdmissionError) as exc:
+        qa.submit(dict(REQ))
+    assert exc.value.http_status == 429
+    # depth 1, avg 40 s, 2 live replicas -> ~20 s, not ~40 s
+    assert exc.value.retry_after_s == 20
+    del b  # (b's heartbeat is on disk either way)
+
+
+def test_concurrent_same_key_submits_yield_one_job(tmp_path):
+    """Review regression: N threads retrying ONE idempotency key
+    against one replica must produce exactly one journaled job —
+    the creation-lock re-check, not just the pre-scan."""
+    import threading
+
+    clk = Clock()
+    a = _member(tmp_path, "a", clk)
+    qa = _queue(tmp_path, a)
+    results = []
+    go = threading.Barrier(6)
+
+    def hammer():
+        go.wait(5)
+        results.append(
+            qa.submit_idempotent(dict(REQ), idempotency_key="k")
+        )
+
+    threads = [threading.Thread(target=hammer) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    ids = {job.id for job, _ in results}
+    assert len(ids) == 1, ids
+    assert sum(1 for _, deduped in results if not deduped) == 1
+    queued = [
+        r
+        for r in _read_entries(qa.journal.path)
+        if r.get("state") == JOB_QUEUED and "event" not in r
+    ]
+    assert len(queued) == 1
+
+
+def test_skewed_running_record_keeps_the_accept_payload(tmp_path):
+    """Review regression: a peer's `running` record whose clock
+    sorts BEFORE the accept record must not become the fold's
+    `first` — request/trace/idempotency_key live on the accept."""
+    clk = Clock()
+    a = _member(tmp_path, "a", clk)
+    ja = ServeJournal(str(tmp_path), replica="a")
+    ja.record(
+        "job-skew", JOB_QUEUED, request=REQ, trace="t9",
+        idempotency_key="kx",
+    )
+    ja.close()
+    # replica b's clock runs 5 s behind: its running record's ts
+    # sorts before the accept
+    jb = ServeJournal(str(tmp_path), replica="b")
+    entry = jb.record("job-skew", JOB_RUNNING, trace="t9")
+    jb.close()
+    import json as _json
+
+    lines = open(jb.path).read().splitlines()
+    entry["ts"] -= 5.0
+    with open(jb.path, "w") as f:
+        for line in lines[:-1]:
+            f.write(line + "\n")
+        f.write(_json.dumps(entry) + "\n")
+    qa = _queue(tmp_path, a)
+    info = qa.fleet_view()["job-skew"]
+    assert info["first"].get("request") == REQ
+    job = qa.get("job-skew")
+    assert job.request == REQ
+    assert job.trace_id == "t9"
+    assert job.idempotency_key == "kx"
+
+
+def test_recover_own_after_restart(tmp_path):
+    clk = Clock()
+    a = _member(tmp_path, "a", clk)
+    qa = _queue(tmp_path, a)
+    job = qa.submit(dict(REQ))
+    assert qa.next_job(0.1) is job
+    qa.mark_running(job)
+    qa.journal.close()
+    # same replica id restarts: it still holds the lease
+    a2 = _member(tmp_path, "a", clk)
+    qa2 = _queue(tmp_path, a2)
+    recovered = qa2.recover_own()
+    assert [j.id for j in recovered] == [job.id]
+    assert recovered[0].resumed is True
+    assert recovered[0].trace_id == job.trace_id
+
+
+def test_orphaned_leases_listing_and_drain_release(tmp_path):
+    clk = Clock()
+    a = _member(tmp_path, "a", clk)
+    qa = _queue(tmp_path, a)
+    job = qa.submit(dict(REQ))
+    assert qa.next_job(0.1) is job
+    qa.mark_running(job)
+    # a live replica's in-flight lease is healthy, not orphaned
+    assert a.orphaned_leases() == []
+    clk.advance(5.0)  # the holder's heartbeat ages out
+    assert a.orphaned_leases() == [job.id]
+    a.ctx.beat()
+    # drain hand-back: queued again, lease released
+    qa.finish(job, JOB_QUEUED, reason="draining past grace")
+    assert a.orphaned_leases() == []
+    assert not os.path.exists(
+        job_lease_path(str(tmp_path), job.id)
+    )
+    view = qa.fleet_view()
+    assert view[job.id]["state"] == JOB_QUEUED
+
+
+@pytest.mark.faults
+def test_replica_crash_site_is_known():
+    assert "replica_crash" in faults.KNOWN_SITES
+    assert "lease_steal" in faults.KNOWN_SITES
+
+
+# -- daemon integration (in-process, real engine over the fixture) ----
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "mini10017"
+)
+SUBMIT = {
+    "in_dir": FIXTURE,
+    "box_size": 180,
+    "options": {"use_mesh": False},
+}
+TERMINAL_DOC = (
+    "finished", "failed", "cancelled", "deadline_exceeded"
+)
+
+
+def _req(port, method, path, body=None, timeout=30):
+    import urllib.error
+    import urllib.request
+
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        method=method,
+        data=(
+            json.dumps(body).encode() if body is not None else None
+        ),
+    )
+    try:
+        with urllib.request.urlopen(r, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _wait_terminal(port, job_id, timeout=120):
+    import time as _time
+
+    deadline = _time.time() + timeout
+    while _time.time() < deadline:
+        code, body = _req(port, "GET", f"/v1/jobs/{job_id}")
+        assert code == 200, body
+        doc = json.loads(body)
+        if doc["state"] in TERMINAL_DOC:
+            return doc
+        _time.sleep(0.05)
+    raise AssertionError(f"job {job_id} never became terminal")
+
+
+def test_fleet_daemon_end_to_end(tmp_path):
+    """One-replica fleet over HTTP: submit -> finished with the
+    shared-queue machinery (lease, commit token, per-replica trace),
+    the /status fleet + breaker sections, and an idempotent retry
+    answered 200 with the original id."""
+    from repic_tpu.serve.daemon import ConsensusDaemon
+
+    fleet = str(tmp_path / "fleet")
+    d = ConsensusDaemon(
+        str(tmp_path / "wd"),
+        port=0,
+        warmup=False,
+        fleet_dir=fleet,
+        replica_id="r1",
+        heartbeat_interval_s=0.2,
+        replica_timeout_s=1.0,
+    )
+    d.start()
+    try:
+        port = d.server.port
+        code, body = _req(
+            port, "POST", "/v1/jobs",
+            dict(SUBMIT, idempotency_key="key-a"),
+        )
+        assert code == 202, body
+        doc0 = json.loads(body)
+        jid = doc0["id"]
+        doc = _wait_terminal(port, jid)
+        assert doc["state"] == "finished", doc
+        assert doc["replica"] == "r1"
+        # exactly-once machinery left its artifacts
+        done = json.load(
+            open(os.path.join(fleet, f"_done.{jid}.json"))
+        )
+        assert done["state"] == "finished"
+        assert not os.path.exists(
+            os.path.join(fleet, f"_joblease.{jid}.json")
+        )
+        # job output lives in the SHARED fleet tree
+        assert os.path.isdir(os.path.join(fleet, "jobs", jid))
+        code, body = _req(port, "GET", f"/v1/jobs/{jid}/artifacts")
+        assert code == 200
+        assert len(json.loads(body)["artifacts"]) == 3
+        # per-replica trace artifact under the accept-time trace id
+        from repic_tpu.telemetry.trace import read_trace
+
+        tr_path = os.path.join(
+            fleet, "jobs", jid, "_trace.r1.jsonl"
+        )
+        assert os.path.exists(tr_path)
+        assert any(
+            r.get("trace") == doc["trace_id"]
+            for r in read_trace(os.path.join(fleet, "jobs", jid))
+        )
+        # /status: fleet section with live replica + breaker detail
+        status = json.loads(_req(port, "GET", "/status")[1])
+        assert status["fleet"]["replica"] == "r1"
+        assert (
+            status["fleet"]["replicas"]["r1"]["rung"] == "live"
+        )
+        assert status["fleet"]["orphaned_leases"] == 0
+        assert status["breaker"]["state"] == "closed"
+        assert status["breaker"]["consecutive_failures"] == 0
+        metrics = _req(port, "GET", "/metrics")[1]
+        assert "repic_serve_breaker_state 0" in metrics
+        assert "repic_serve_breaker_failures 0" in metrics
+        assert "repic_fleet_replicas_live" in metrics
+        # idempotent retry: 200, same job, deduped flag
+        code, body = _req(
+            port, "POST", "/v1/jobs",
+            dict(SUBMIT, idempotency_key="key-a"),
+        )
+        assert code == 200, body
+        retry = json.loads(body)
+        assert retry["id"] == jid
+        assert retry["deduped"] is True
+    finally:
+        d.drain()
+    # a clean drain leaves zero orphaned leases behind
+    probe = FleetMember(fleet, "probe")
+    assert probe.orphaned_leases() == []
+
+
+def test_fleet_two_daemons_share_one_queue(tmp_path):
+    """Two live replicas, one fleet dir: submissions to one replica
+    are visible (doc + artifacts) from the other, and every job
+    finishes exactly once somewhere in the fleet."""
+    from repic_tpu.serve.daemon import ConsensusDaemon
+
+    fleet = str(tmp_path / "fleet")
+    ds = [
+        ConsensusDaemon(
+            str(tmp_path / f"wd{i}"),
+            port=0,
+            warmup=False,
+            fleet_dir=fleet,
+            replica_id=f"r{i}",
+            heartbeat_interval_s=0.2,
+            replica_timeout_s=1.0,
+        ).start()
+        for i in (1, 2)
+    ]
+    try:
+        p1, p2 = (d.server.port for d in ds)
+        ids = []
+        # submitted sequentially (next only after the previous is
+        # terminal): two jobs running at once in ONE process would
+        # interleave the run-scoped global event log — a test-only
+        # hazard (real replicas are separate processes)
+        for _ in range(2):
+            code, body = _req(p1, "POST", "/v1/jobs", SUBMIT)
+            assert code == 202, body
+            jid = json.loads(body)["id"]
+            ids.append(jid)
+            _wait_terminal(p2, jid)
+        for jid in ids:
+            # poll the OTHER replica: any replica answers any job
+            doc = _wait_terminal(p2, jid)
+            assert doc["state"] == "finished", doc
+            assert doc["replica"] in ("r1", "r2")
+            code, body = _req(
+                p2, "GET", f"/v1/jobs/{jid}/artifacts"
+            )
+            assert code == 200
+            assert len(json.loads(body)["artifacts"]) == 3
+            terminal = [
+                r
+                for r in _all_state_records(fleet, jid)
+                if r["state"] in TERMINAL_STATES
+            ]
+            assert len(terminal) == 1, terminal
+        # the job list on either replica covers the whole fleet
+        listing = json.loads(_req(p2, "GET", "/v1/jobs")[1])
+        assert {j["id"] for j in listing["jobs"]} >= set(ids)
+    finally:
+        for d in ds:
+            d.drain()

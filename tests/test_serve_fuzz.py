@@ -1,0 +1,344 @@
+"""Fuzz/property tests for the untrusted job-payload boundary.
+
+The serve contract: a malformed POST /v1/jobs body can only ever
+cost the client a 400 — never a 5xx, never a worker crash, never an
+unbounded buffer.  These tests hold the two validation layers
+(:func:`repic_tpu.serve.daemon.validate_submission` and
+:meth:`repic_tpu.pipeline.engine.ConsensusOptions.from_dict`) to
+"ValueError or a valid result, nothing else" under a seeded
+generative sweep (malformed JSON, wrong types everywhere, oversized
+fields, non-finite numbers, deep nesting), then round-trips a
+selection over real HTTP to pin the 400 mapping.
+"""
+
+import itertools
+import json
+import math
+import os
+import random
+import string
+
+import pytest
+
+from repic_tpu.pipeline.engine import ConsensusOptions
+from repic_tpu.serve.daemon import (
+    MAX_BODY_BYTES,
+    validate_submission,
+)
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "mini10017"
+)
+
+#: every field either validator knows, plus traps
+FIELDS = (
+    "in_dir", "box_size", "options", "deadline_s", "bucket_hint",
+    "idempotency_key", "typo_field", "__proto__",
+)
+OPTION_FIELDS = (
+    "threshold", "max_neighbors", "num_particles", "use_mesh",
+    "spatial", "solver", "use_pallas", "strict", "max_retries",
+    "nope",
+)
+
+
+def _weird_values(rng):
+    """A generator of adversarial JSON-representable values."""
+    deep = x = []
+    for _ in range(40):
+        x.append([])
+        x = x[0]
+    return [
+        None, True, False, 0, -1, 1, 2**63, -(2**63),
+        0.0, -0.0, 1e308, -1e308, float("inf"), float("-inf"),
+        float("nan"), 0.3, "", "x", "0.5", "greedy", "exact",
+        "\x00", "‮", "a" * 10_000, [], [[]], deep, {}, {"": ""},
+        {"a": {"b": {"c": 1}}}, [1, 2, 3], ["a"], [None],
+        rng.random(), rng.randint(-(10**9), 10**9),
+        "".join(
+            rng.choice(string.printable) for _ in range(20)
+        ),
+    ]
+
+
+def _check_validate(body: bytes):
+    """The property: ValueError (-> 400) or a well-formed tuple."""
+    try:
+        out = validate_submission(body)
+    except ValueError:
+        return None
+    request, options, deadline_s, hint, key = out
+    assert isinstance(request, dict)
+    assert isinstance(options, ConsensusOptions)
+    assert deadline_s is None or (
+        isinstance(deadline_s, float)
+        and math.isfinite(deadline_s)
+        and deadline_s > 0
+    )
+    assert hint is None or (isinstance(hint, int) and hint >= 1)
+    assert key is None or (isinstance(key, str) and key)
+    return out
+
+
+def test_options_from_dict_never_crashes_on_weird_values():
+    rng = random.Random(1234)
+    values = _weird_values(rng)
+    for field in OPTION_FIELDS:
+        for v in values:
+            try:
+                opts = ConsensusOptions.from_dict({field: v})
+            except ValueError:
+                continue
+            # accepted values must round-trip as sane types
+            assert isinstance(opts.threshold, (int, float))
+            assert not isinstance(opts.use_mesh, str)
+
+
+def test_options_from_dict_rejects_wrong_types_explicitly():
+    bad = [
+        {"threshold": "0.5"},
+        {"threshold": [0.5]},
+        {"threshold": True},
+        {"threshold": float("nan")},
+        {"threshold": float("inf")},
+        {"threshold": 0.0},
+        {"threshold": 2.0},
+        {"max_neighbors": 0},
+        {"max_neighbors": 1.5},
+        {"max_neighbors": False},
+        {"max_neighbors": 10**9},
+        {"num_particles": -3},
+        {"num_particles": "many"},
+        {"use_mesh": 1},
+        {"use_mesh": "yes"},
+        {"strict": None},
+        {"spatial": "auto"},
+        {"solver": 5},
+        {"solver": "exact"},
+        {"max_retries": -1},
+        {"max_retries": 3.5},
+        {"unknown_knob": 1},
+        "not a dict",
+        [("threshold", 0.5)],
+    ]
+    for payload in bad:
+        with pytest.raises(ValueError):
+            ConsensusOptions.from_dict(payload)
+
+
+def test_options_from_dict_accepts_the_valid_envelope():
+    opts = ConsensusOptions.from_dict(
+        {
+            "threshold": 0.3,
+            "max_neighbors": 16,
+            "num_particles": 100,
+            "use_mesh": False,
+            "spatial": None,
+            "solver": "lp",
+            "use_pallas": False,
+            "strict": True,
+            "max_retries": 2,
+        }
+    )
+    assert opts.solver == "lp"
+    assert opts.strict is True
+
+
+def test_validate_submission_malformed_bytes_yield_400():
+    cases = [
+        b"",
+        b"not json",
+        b"[]",
+        b'"a string"',
+        b"123",
+        b"null",
+        b"{",
+        b'{"in_dir": }',
+        b"\xff\xfe\x00garbage",
+        '{"in_dir": "‮"}'.encode(),
+        b'{"in_dir": "/tmp", "box_size": 180, "box_size": 190',
+        json.dumps({"in_dir": FIXTURE}).encode(),  # no box_size
+        # falsy wrong-typed options must NOT coerce to defaults
+        json.dumps(
+            {"in_dir": FIXTURE, "box_size": 180, "options": []}
+        ).encode(),
+        json.dumps(
+            {"in_dir": FIXTURE, "box_size": 180, "options": 0}
+        ).encode(),
+        json.dumps(
+            {"in_dir": FIXTURE, "box_size": 180, "options": False}
+        ).encode(),
+        # JSON-level Infinity/NaN literals (json.loads accepts them)
+        b'{"in_dir": "%s", "box_size": Infinity}'
+        % FIXTURE.encode(),
+        b'{"in_dir": "%s", "box_size": NaN}' % FIXTURE.encode(),
+        b'{"in_dir": "%s", "box_size": 180, "deadline_s": '
+        b"Infinity}" % FIXTURE.encode(),
+    ]
+    for body in cases:
+        try:
+            out = validate_submission(body)
+        except ValueError:
+            continue
+        raise AssertionError(f"accepted {body[:60]!r}: {out}")
+
+
+def test_validate_submission_oversized_fields_yield_400():
+    huge = {"in_dir": FIXTURE, "box_size": 180}
+    with pytest.raises(ValueError):
+        validate_submission(b"x" * (MAX_BODY_BYTES + 1))
+    with pytest.raises(ValueError):
+        validate_submission(
+            json.dumps(dict(huge, in_dir="/" + "a" * 5000)).encode()
+        )
+    with pytest.raises(ValueError):
+        validate_submission(
+            json.dumps(dict(huge, box_size=[180] * 100)).encode()
+        )
+    with pytest.raises(ValueError):
+        validate_submission(
+            json.dumps(
+                dict(huge, idempotency_key="k" * 500)
+            ).encode()
+        )
+    # at the boundary: still valid
+    ok = validate_submission(
+        json.dumps(dict(huge, idempotency_key="k" * 200)).encode()
+    )
+    assert ok[4] == "k" * 200
+
+
+def test_validate_submission_generative_sweep():
+    """Seeded sweep: random field/value combinations (plus raw byte
+    mutations of a valid body) must satisfy the 400-or-valid
+    property — no TypeError, KeyError, RecursionError, OSError out
+    of the validator."""
+    rng = random.Random(20260803)
+    values = _weird_values(rng)
+    # single-field corruption over a valid base
+    base = {"in_dir": FIXTURE, "box_size": 180}
+    for field, v in itertools.product(FIELDS, values):
+        payload = dict(base)
+        payload[field] = v
+        _check_validate(
+            json.dumps(payload, default=str).encode()
+        )
+    # options-field corruption
+    for field, v in itertools.product(OPTION_FIELDS, values):
+        payload = dict(base, options={field: v})
+        _check_validate(
+            json.dumps(payload, default=str).encode()
+        )
+    # random byte mutations of a valid body
+    valid = json.dumps(
+        dict(base, options={"use_mesh": False}, deadline_s=5)
+    ).encode()
+    for _ in range(300):
+        body = bytearray(valid)
+        for _ in range(rng.randint(1, 4)):
+            pos = rng.randrange(len(body))
+            body[pos] = rng.randrange(256)
+        _check_validate(bytes(body))
+    # random full-random bodies
+    for _ in range(200):
+        n = rng.randint(0, 64)
+        _check_validate(
+            bytes(rng.randrange(256) for _ in range(n))
+        )
+
+
+def test_http_maps_validation_to_400_and_413(tmp_path):
+    """Round-trip a malicious selection over real HTTP: the daemon
+    answers 400 (or 413 for an oversized body) and the worker stays
+    alive to run a valid job afterwards."""
+    import urllib.error
+    import urllib.request
+
+    from repic_tpu.serve.daemon import ConsensusDaemon
+
+    d = ConsensusDaemon(
+        str(tmp_path / "wd"), port=0, warmup=False, queue_limit=4
+    )
+    d.start()
+    try:
+        port = d.server.port
+
+        def post(raw: bytes):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/jobs",
+                method="POST",
+                data=raw,
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return r.status, r.read().decode()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read().decode()
+
+        bad = [
+            b"not json",
+            b"[1, 2, 3]",
+            json.dumps({"in_dir": FIXTURE}).encode(),
+            json.dumps(
+                {"in_dir": FIXTURE, "box_size": 180,
+                 "options": {"threshold": "NaN"}}
+            ).encode(),
+            json.dumps(
+                {"in_dir": FIXTURE, "box_size": [180, -1]}
+            ).encode(),
+            b'{"in_dir": "%s", "box_size": Infinity}'
+            % FIXTURE.encode(),
+        ]
+        for raw in bad:
+            code, body = post(raw)
+            assert code == 400, (raw[:60], code, body)
+        # an oversized body is refused before buffering: a 413 when
+        # the client manages to read it, or a dropped connection if
+        # the server's refusal lands while the client is still
+        # sending — either way the daemon never buffers the payload
+        try:
+            code, _ = post(b"x" * (5 << 20))
+            assert code == 413
+        except (urllib.error.URLError, OSError):
+            pass
+        # a negative (or garbage) Content-Length must not reach
+        # read(-1) and buffer until the client hangs up
+        import http.client
+
+        for bad_len in ("-1", "nope"):
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", port, timeout=30
+            )
+            try:
+                conn.putrequest("POST", "/v1/jobs")
+                conn.putheader("Content-Length", bad_len)
+                conn.endheaders()
+                resp = conn.getresponse()
+                assert resp.status in (400, 413), (
+                    bad_len, resp.status
+                )
+            finally:
+                conn.close()
+        # the worker survived all of it: a valid job still runs
+        code, body = post(
+            json.dumps(
+                {"in_dir": FIXTURE, "box_size": 180,
+                 "options": {"use_mesh": False}}
+            ).encode()
+        )
+        assert code == 202, body
+        jid = json.loads(body)["id"]
+        import time as _time
+
+        deadline = _time.time() + 120
+        while _time.time() < deadline:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/jobs/{jid}", timeout=30
+            ) as r:
+                doc = json.loads(r.read().decode())
+            if doc["state"] not in ("queued", "running"):
+                break
+            _time.sleep(0.05)
+        assert doc["state"] == "finished", doc
+    finally:
+        d.drain()
