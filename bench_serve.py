@@ -1,0 +1,303 @@
+#!/usr/bin/env python3
+"""Serving-throughput bench: continuous batching vs single-job.
+
+Drives N concurrent clients against two freshly-spawned local
+``repic-tpu serve`` daemons — one per scheduler — with a
+**many-small-jobs mixed workload**: small consensus jobs of VARIED
+micrograph counts (real clients submit whatever they have) plus one
+large job, all sharing a particle-capacity bucket.  Measures, per
+scheduler:
+
+* **cold burst** — the whole workload against a cold daemon (fresh
+  process, persistent compile cache off): this is where the
+  single-job scheduler fragments the program cache (one XLA compile
+  per distinct job size — its chunk shape is the job's micrograph
+  count) while the continuous batcher coalesces every job onto its
+  small chunk-shape ladder and compiles ~2 programs total.
+* **steady state** — the same burst repeated ``--rounds`` times; the
+  best post-cold round is the warm number (capacity configs and
+  chunk shapes have converged).
+* **p95 small-job latency** — accept -> terminal, small jobs only
+  (the fair-share / head-of-line story).
+
+Artifacts are byte-compared across the two schedulers per workload
+item — coalescing must not change a single output byte.
+
+Output is one BENCH-shape row (micrographs/sec headline + the
+breakdown), compatible with ``scripts/bench_compare.py --history``.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python bench_serve.py [--out BENCH_SERVE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+#: small-job micrograph counts — deliberately varied: each distinct
+#: size is its own chunk shape (= its own XLA compile) under the
+#: single-job scheduler, and just more rows to coalesce under the
+#: batcher (which executes the whole mix on its {4, 16} shape
+#: ladder regardless)
+SMALL_SIZES = (1, 2, 3, 4, 5, 6, 7, 8, 1, 2, 3, 4, 5, 6, 7, 8)
+LARGE_MICS = 24
+TERMINAL = ("finished", "failed", "cancelled", "deadline_exceeded")
+
+
+def make_workload(root: str, particles: int, seed: int = 11):
+    """Synthesize picker BOX directories: len(SMALL_SIZES) small
+    jobs + 1 large, 3 pickers each, one shared capacity bucket."""
+    import numpy as np
+
+    from repic_tpu.utils import box_io
+
+    rng = np.random.default_rng(seed)
+
+    def make_dir(path, mics):
+        for p in ("alpha", "beta", "gamma"):
+            os.makedirs(os.path.join(path, p), exist_ok=True)
+            for i in range(mics):
+                xy = rng.uniform(
+                    0, 4000, (particles, 2)
+                ).astype(np.float32)
+                conf = rng.uniform(
+                    0.5, 1.0, particles
+                ).astype(np.float32)
+                box_io.write_box(
+                    os.path.join(path, p, f"m{i:03d}.box"),
+                    xy, conf, 180,
+                )
+
+    dirs = []
+    for j, s in enumerate(SMALL_SIZES):
+        d = os.path.join(root, f"small{j:02d}")
+        make_dir(d, s)
+        dirs.append(d)
+    large = os.path.join(root, "large")
+    make_dir(large, LARGE_MICS)
+    # the large job lands mid-burst: the head-of-line case
+    mid = len(dirs) // 2
+    return dirs[:mid] + [large] + dirs[mid:]
+
+
+def spawn_daemon(wd: str, scheduler: str, max_open: int):
+    env = dict(
+        os.environ,
+        REPIC_TPU_NO_CONFIG_CACHE="1",  # measure THIS process only
+    )
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "repic_tpu.main", "serve", wd,
+         "--port", "0", "--scheduler", scheduler,
+         "--max-open", str(max_open), "--queue-limit", "256",
+         "--compile-cache", "off",  # architecture, not disk reuse
+         "--no-warmup"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env,
+    )
+    info = os.path.join(wd, "_serve.json")
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                "daemon died at startup:\n" + proc.communicate()[0]
+            )
+        try:
+            with open(info) as f:
+                doc = json.load(f)
+            if doc.get("pid") == proc.pid:
+                return proc, doc["port"]
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.1)
+    proc.kill()
+    raise RuntimeError("daemon never wrote _serve.json")
+
+
+def _req(port, method, path, body=None, timeout=300):
+    r = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        method=method,
+        data=(
+            json.dumps(body).encode() if body is not None else None
+        ),
+    )
+    try:
+        with urllib.request.urlopen(r, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def run_burst(port, workload, clients: int):
+    """Submit the whole workload from ``clients`` concurrent client
+    threads; wait for every job; return (makespan_s, [(in_dir,
+    job_id, latency_s), ...])."""
+
+    def one(in_dir):
+        code, body = _req(port, "POST", "/v1/jobs", {
+            "in_dir": in_dir,
+            "box_size": 180,
+            "options": {"use_mesh": False},
+        })
+        assert code == 202, (code, body)
+        jid = json.loads(body)["id"]
+        while True:
+            code, body = _req(port, "GET", f"/v1/jobs/{jid}")
+            assert code == 200, body
+            doc = json.loads(body)
+            if doc["state"] in TERMINAL:
+                assert doc["state"] == "finished", doc
+                return (
+                    in_dir, jid,
+                    doc["finished_ts"] - doc["accepted_ts"],
+                )
+            time.sleep(0.02)
+
+    t0 = time.time()
+    with ThreadPoolExecutor(max_workers=clients) as ex:
+        rows = list(ex.map(one, workload))
+    return time.time() - t0, rows
+
+
+def read_artifacts(wd: str, jid: str) -> dict:
+    d = os.path.join(wd, "jobs", jid)
+    out = {}
+    for name in sorted(os.listdir(d)):
+        if name.endswith(".box"):
+            with open(os.path.join(d, name), "rb") as f:
+                out[name] = f.read()
+    return out
+
+
+def bench_one(scheduler, workload, wd, *, clients, rounds,
+              max_open):
+    proc, port = spawn_daemon(wd, scheduler, max_open)
+    try:
+        total_mics = sum(SMALL_SIZES) + LARGE_MICS
+        cold_s, rows = run_burst(port, workload, clients)
+        lat = {r[0]: r[2] for r in rows}
+        small = sorted(
+            v for k, v in lat.items()
+            if not k.endswith("large")
+        )
+        p95 = small[int(0.95 * (len(small) - 1))]
+        steadies = []
+        for _ in range(max(rounds - 1, 1)):
+            mk, _ = run_burst(port, workload, clients)
+            steadies.append(mk)
+        steady_s = min(steadies)
+        arts = {
+            in_dir: read_artifacts(wd, jid)
+            for in_dir, jid, _ in rows
+        }
+        return {
+            "scheduler": scheduler,
+            "cold_burst_s": round(cold_s, 3),
+            "cold_mic_s": round(total_mics / cold_s, 2),
+            "steady_s": round(steady_s, 3),
+            "steady_mic_s": round(total_mics / steady_s, 2),
+            "small_p95_cold_s": round(p95, 3),
+            "large_latency_cold_s": round(
+                lat[workload[len(workload) // 2]], 3
+            ),
+        }, arts
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--clients", type=int, default=4)
+    parser.add_argument("--rounds", type=int, default=4)
+    parser.add_argument("--particles", type=int, default=120)
+    parser.add_argument("--max-open", type=int, default=8)
+    parser.add_argument("--out", default=None,
+                        help="also write the BENCH row here")
+    parser.add_argument("--keep", action="store_true",
+                        help="keep the scratch directory")
+    args = parser.parse_args(argv)
+
+    scratch = tempfile.mkdtemp(prefix="bench_serve_")
+    try:
+        workload = make_workload(scratch, args.particles)
+        results = {}
+        artifacts = {}
+        for scheduler in ("single", "batch"):
+            wd = os.path.join(scratch, f"wd-{scheduler}")
+            results[scheduler], artifacts[scheduler] = bench_one(
+                scheduler, workload, wd,
+                clients=args.clients, rounds=args.rounds,
+                max_open=args.max_open,
+            )
+            print(json.dumps(results[scheduler]), file=sys.stderr)
+        identical = artifacts["single"] == artifacts["batch"]
+        single, batch = results["single"], results["batch"]
+        row = {
+            "metric": (
+                "serve mixed small-job burst, continuous batching, "
+                "end-to-end"
+            ),
+            # headline: cold-burst throughput with the batcher — the
+            # first-hour-of-traffic number the tentpole targets
+            "value": batch["cold_mic_s"],
+            "unit": "micrographs/sec",
+            "platform": os.environ.get("JAX_PLATFORMS", "cpu")
+            .split(",")[0],
+            "first_call_s": batch["cold_burst_s"],
+            "warm_total_s": batch["steady_s"],
+            "speedup_cold": round(
+                batch["cold_mic_s"] / single["cold_mic_s"], 2
+            ),
+            "speedup_steady": round(
+                batch["steady_mic_s"] / single["steady_mic_s"], 2
+            ),
+            "p95_small_cold_s": {
+                "single": single["small_p95_cold_s"],
+                "batch": batch["small_p95_cold_s"],
+            },
+            "artifacts_identical": identical,
+            "single": single,
+            "batch": batch,
+            "workload": {
+                "small_sizes": list(SMALL_SIZES),
+                "large_mics": LARGE_MICS,
+                "particles": args.particles,
+                "clients": args.clients,
+            },
+        }
+        print(json.dumps(row))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(row, f, indent=1)
+        if not identical:
+            print("FAIL: artifacts differ between schedulers",
+                  file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        if args.keep:
+            print(f"scratch kept at {scratch}", file=sys.stderr)
+        else:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,47 @@ def add_arguments(parser):
         "immediately and the first request pays the first compile",
     )
     parser.add_argument(
+        "--scheduler",
+        choices=("batch", "single"),
+        default="batch",
+        help="'batch' (default): the continuous batcher coalesces "
+        "queued micrographs from DIFFERENT requests into one padded "
+        "capacity-bucket chunk at every chunk boundary, with "
+        "fair-share interleaving so small jobs ride along with a "
+        "large one.  'single' restores the one-job-at-a-time worker "
+        "(the bench_serve.py comparison baseline)",
+    )
+    parser.add_argument(
+        "--max-open",
+        type=int,
+        default=4,
+        metavar="N",
+        help="jobs the batch scheduler holds open at once — the "
+        "coalescing window (default 4; scheduler=batch only)",
+    )
+    parser.add_argument(
+        "--compile-cache",
+        default="auto",
+        metavar="DIR",
+        help="persistent XLA compilation cache + program-signature "
+        "sidecar, shipped as a deploy artifact so a restarted "
+        "daemon (or a fresh fleet replica) serves its first request "
+        "warm.  Default 'auto': <fleet_dir>/_compile_cache in fleet "
+        "mode, else <work_dir>/_compile_cache; "
+        "$REPIC_TPU_COMPILE_CACHE overrides; 'off' disables "
+        "(docs/serving.md)",
+    )
+    parser.add_argument(
+        "--warmup-bucket",
+        action="append",
+        default=None,
+        metavar="K:N",
+        help="ahead-of-time warm a declared capacity bucket (K "
+        "pickers, N particle capacity) during startup warmup; "
+        "repeatable.  Buckets previously served are replayed "
+        "automatically from the compile-cache sidecar",
+    )
+    parser.add_argument(
         "--fleet-dir",
         default=None,
         metavar="DIR",
@@ -144,6 +185,12 @@ def main(args):
     except ValueError as e:
         raise SystemExit(f"repic-tpu serve: {e}") from e
     try:
+        from repic_tpu.pipeline.engine import parse_warmup_buckets
+
+        warmup_buckets = parse_warmup_buckets(args.warmup_bucket)
+    except ValueError as e:
+        raise SystemExit(f"repic-tpu serve: {e}") from e
+    try:
         daemon = ConsensusDaemon(
             args.work_dir,
             port=args.port,
@@ -158,6 +205,10 @@ def main(args):
             replica_id=args.replica_id,
             heartbeat_interval_s=args.heartbeat_interval,
             replica_timeout_s=args.replica_timeout,
+            scheduler=args.scheduler,
+            max_open=args.max_open,
+            compile_cache=args.compile_cache,
+            warmup_buckets=warmup_buckets,
         )
     except ValueError as e:
         raise SystemExit(f"repic-tpu serve: {e}") from e

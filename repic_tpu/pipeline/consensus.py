@@ -103,6 +103,60 @@ _PROGRAM_MISSES = telemetry.counter(
 _PROGRAM_SIGNATURES: set = set()
 
 
+def program_signature(
+    threshold, d, cap, mesh_flag, grid, cell_cap, solver,
+    use_pallas, pcap, shape,
+) -> tuple:
+    """The static-signature tuple keying one compiled executable —
+    exactly what :func:`run_consensus_batch` executes for a given
+    config + input shape (the RT105 fingerprint, live)."""
+    return (
+        float(threshold), int(d), int(cap), bool(mesh_flag),
+        None if grid is None else int(grid), int(cell_cap),
+        str(solver), bool(use_pallas), int(pcap), tuple(shape),
+    )
+
+
+def note_program_signature(sig: tuple) -> bool:
+    """Mark ``sig`` as compiled this process WITHOUT counting a
+    cache hit or miss — the warmup-replay entry point
+    (:func:`repic_tpu.pipeline.engine.warmup_from_cache`): programs
+    compiled ahead of traffic make the first real request a HIT on
+    the counters, which is what they are.  Returns True when the
+    signature was already known."""
+    if sig in _PROGRAM_SIGNATURES:
+        return True
+    _PROGRAM_SIGNATURES.add(sig)
+    return False
+
+
+def _persist_program_signature(sig: tuple, box_rank: int) -> None:
+    """Record an executed signature in the persistent compile-cache
+    sidecar (no-op unless ``runtime.compilecache.enable`` ran) so a
+    restarted process can replay-warm it.  ``box_rank`` rides along:
+    the box-size argument's rank (scalar vs per-picker vector) is an
+    input shape the replay must reproduce."""
+    from repic_tpu.runtime import compilecache
+
+    if compilecache.enabled_dir() is None:
+        return
+    (threshold, d, cap, mesh_flag, grid, cell_cap, solver,
+     use_pallas, pcap, shape) = sig
+    compilecache.record_program({
+        "threshold": threshold,
+        "max_neighbors": d,
+        "clique_capacity": cap,
+        "mesh": mesh_flag,
+        "spatial_grid": grid,
+        "cell_capacity": cell_cap,
+        "solver": solver,
+        "use_pallas": use_pallas,
+        "partial_capacity": pcap,
+        "shape": list(shape),
+        "box_rank": int(box_rank),
+    })
+
+
 class ConsensusCancelled(RuntimeError):
     """Cooperative cancellation observed at a chunk boundary.
 
@@ -751,7 +805,7 @@ def run_consensus_batch(
         # Cache-effectiveness probe: the executable actually reused is
         # keyed by this exact (static config, input shape) signature —
         # the same signature RT105 fingerprints at check time.
-        sig = (
+        sig = program_signature(
             threshold, d, cap, mesh is not None, grid, cell_cap,
             solver, use_pallas, pcap, batch.xy.shape,
         )
@@ -760,6 +814,7 @@ def run_consensus_batch(
         else:
             _PROGRAM_SIGNATURES.add(sig)
             _PROGRAM_MISSES.inc()
+            _persist_program_signature(sig, box_rank=sizes.ndim)
         xy, conf, mask = batch.xy, batch.conf, batch.mask
         if mesh is not None:
             xy, conf, mask = shard_over_micrographs(mesh, xy, conf, mask)

@@ -190,11 +190,16 @@ class RequestPlan:
         """The padded-capacity-bucket handle for warm-affinity
         scheduling: requests sharing it execute the same static
         program signature (before data-driven escalation), so
-        running them back-to-back skips recompiles."""
+        running them back-to-back skips recompiles — and the
+        continuous batcher coalesces their micrographs into one
+        chunk.  Deliberately EXCLUDES the micrograph count and the
+        derived chunk size: two requests differing only in how many
+        micrographs they carry (or what they are called) must share
+        a bucket, or every job size would fragment the program cache
+        (the regression tests/test_engine.py pins)."""
         return (
             self.num_pickers,
             self.capacity,
-            self.chunk,
             self.options.threshold,
             self.options.solver,
         )
@@ -394,4 +399,139 @@ def warmup(
         "num_pickers": k,
         "capacity": n,
         "compile_s": round(time.time() - t0, 3),
+    }
+
+
+def parse_warmup_buckets(specs) -> list:
+    """``--warmup-bucket K:N`` parser -> ``[(num_pickers,
+    capacity), ...]`` (deduped, order kept).  Malformed specs raise
+    ``ValueError`` with the offending text."""
+    out: list = []
+    for spec in specs or ():
+        try:
+            k_s, n_s = str(spec).split(":", 1)
+            k, n = int(k_s), int(n_s)
+            if k < 2 or n < 1:
+                raise ValueError
+        except ValueError:
+            raise ValueError(
+                f"bad --warmup-bucket {spec!r} "
+                "(want K:N, e.g. 3:256 — K pickers, N particle "
+                "capacity, K >= 2)"
+            ) from None
+        if (k, n) not in out:
+            out.append((k, n))
+    return out
+
+
+def warmup_buckets(buckets, *, box_size: float = 180.0) -> list:
+    """AOT-warm a declared list of ``(num_pickers, capacity)``
+    capacity buckets (one :func:`warmup` each).  Best-effort shape
+    coverage for buckets the operator KNOWS are coming before any
+    request ever hit them; the exact-program half of cold-start
+    removal is :func:`warmup_from_cache`."""
+    return [
+        warmup(k, n, box_size=box_size) for k, n in buckets or ()
+    ]
+
+
+def warmup_from_cache(
+    max_programs: int | None = None,
+    budget_s: float | None = 300.0,
+) -> dict:
+    """Replay every program signature recorded in the persistent
+    compile-cache sidecar (``runtime.compilecache``): compile each
+    exact executable — through the on-disk XLA cache, so a restarted
+    replica pays milliseconds of deserialization per program instead
+    of a fresh compile — and register its signature as warm, so the
+    first real request on any previously-seen capacity bucket is a
+    program-cache HIT with a ~0 compile segment in its trace.
+
+    Returns a summary for the serve journal: programs replayed /
+    failed / skipped, wall seconds, and the persistent-hit vs
+    fresh-compile split observed while replaying.  Best-effort per
+    entry: one unreplayable signature (e.g. recorded on a
+    differently-sized mesh) is counted and skipped, never fatal.
+
+    ``budget_s`` bounds the replay wall clock: a sidecar whose XLA
+    blobs are missing or version-invalidated turns every replay into
+    a FRESH compile (51.6 s each on the round-5 TPU), and an
+    unbounded loop over up to 128 of those would hold readiness red
+    for over an hour — remaining entries are counted ``skipped`` and
+    the first real request pays its own compile instead.
+    """
+    import time
+
+    import numpy as np
+
+    from repic_tpu.parallel.mesh import consensus_mesh
+    from repic_tpu.pipeline.consensus import (
+        note_program_signature,
+        program_signature,
+    )
+    from repic_tpu.runtime import compilecache
+    from repic_tpu.telemetry import probes as tlm_probes
+
+    tlm_probes.install()
+    entries = compilecache.load_programs()
+    if max_programs is not None:
+        entries = entries[-int(max_programs):]
+    t0 = time.time()
+    hits0 = tlm_probes.persistent_cache_hits()
+    hit_s0 = tlm_probes.persistent_cache_hit_seconds()
+    fresh0 = tlm_probes.fresh_compiles()
+    warmed = failed = skipped = 0
+    for i, e in enumerate(entries):
+        if budget_s is not None and time.time() - t0 > budget_s:
+            skipped = len(entries) - i
+            break
+        try:
+            shape = tuple(int(v) for v in e["shape"])
+            m, k, n, _ = shape
+            sig = program_signature(
+                e["threshold"], e["max_neighbors"],
+                e["clique_capacity"], e["mesh"], e["spatial_grid"],
+                e["cell_capacity"], e["solver"], e["use_pallas"],
+                e["partial_capacity"], shape,
+            )
+            mesh = consensus_mesh() if e["mesh"] else None
+            fn = make_batched_consensus(
+                threshold=e["threshold"],
+                max_neighbors=e["max_neighbors"],
+                clique_capacity=e["clique_capacity"],
+                mesh=mesh,
+                spatial_grid=e["spatial_grid"],
+                cell_capacity=e["cell_capacity"],
+                solver=e["solver"],
+                use_pallas=e["use_pallas"],
+                partial_capacity=e["partial_capacity"],
+            )
+            box = (
+                np.full((k,), 180.0, np.float32)
+                if int(e.get("box_rank", 0))
+                else 180.0
+            )
+            res = fn(
+                jnp.zeros((m, k, n, 2), jnp.float32),
+                jnp.zeros((m, k, n), jnp.float32),
+                jnp.zeros((m, k, n), bool),
+                box,
+            )
+            jax.block_until_ready(res.picked)
+            note_program_signature(sig)
+            warmed += 1
+        except Exception:  # noqa: BLE001 — per-entry best effort
+            failed += 1
+    return {
+        "programs_warmed": warmed,
+        "programs_failed": failed,
+        "programs_skipped": skipped,
+        "wall_s": round(time.time() - t0, 3),
+        "persistent_cache_hits": (
+            tlm_probes.persistent_cache_hits() - hits0
+        ),
+        "persistent_hit_s": round(
+            tlm_probes.persistent_cache_hit_seconds() - hit_s0, 3
+        ),
+        "fresh_compiles": tlm_probes.fresh_compiles() - fresh0,
     }

@@ -1,0 +1,167 @@
+"""Persistent compilation cache: serve the first request warm.
+
+The round-5 TPU headline carried a **51.6 s first-call compile**
+(BENCH_TPU_LAST.json) — the single biggest "millions of users" lever
+in ROADMAP item 1: every daemon restart, every fresh fleet replica,
+and every redeploy re-paid it before serving its first request.  Two
+artifacts, shipped together as ONE deploy directory, kill it:
+
+* **XLA executables** — ``jax_compilation_cache_dir`` pointed at the
+  directory (:func:`enable`).  XLA then serializes every compiled
+  executable to disk and deserializes on the next compile of the same
+  program, across process restarts and across machines sharing the
+  directory.  The entry-size / min-compile-time floors are disabled:
+  CPU consensus programs often compile in under a second and would
+  otherwise silently never persist, making restart-warm CI
+  impossible to verify off-TPU.
+* **Program signatures** — ``programs.json``
+  (:func:`record_program` / :func:`load_programs`): the exact static
+  signatures :func:`repic_tpu.pipeline.consensus.run_consensus_batch`
+  executed (threshold, capacities, mesh/spatial/solver knobs, batch
+  shape).  The serve daemon's startup warmup replays them
+  (:func:`repic_tpu.pipeline.engine.warmup_from_cache`), compiling
+  each through the persistent XLA cache — so a restarted replica (or
+  a brand-new fleet member pointed at the shared fleet cache) has
+  every previously-seen capacity bucket compiled and registered as
+  warm BEFORE readiness goes green.
+
+Both halves are best-effort optimizations, never correctness
+dependencies: a missing/corrupt sidecar warms nothing, a cold XLA
+cache just compiles — the same contract as the capacity-config
+sidecar (:mod:`repic_tpu.pipeline.consensus`).  Operator recipe:
+docs/serving.md "Compile cache as a deploy artifact".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+PROGRAMS_NAME = "programs.json"
+ENV_DIR = "REPIC_TPU_COMPILE_CACHE"
+#: sidecar bound: one entry per distinct program signature — far
+#: more than any serving workload's live bucket set.  The replay
+#: side (``engine.warmup_from_cache``) additionally carries a
+#: wall-clock budget, so even a sidecar whose XLA blobs were
+#: invalidated (every replay a fresh compile) cannot hold readiness
+#: red indefinitely.
+MAX_PROGRAMS = 128
+
+_lock = threading.Lock()
+_enabled_dir: str | None = None
+_seen: set = set()
+
+
+def resolve_dir(explicit: str | None, default: str) -> str | None:
+    """The cache directory an entry point should use: an explicit
+    path wins, then ``$REPIC_TPU_COMPILE_CACHE``, then ``default``.
+    The explicit value ``"off"`` (or an env var of ``"off"``/``"0"``)
+    disables persistence entirely (returns None)."""
+    choice = explicit or os.environ.get(ENV_DIR) or default
+    if not choice or str(choice).lower() in ("off", "0", "none"):
+        return None
+    return os.path.abspath(choice)
+
+
+def enable(cache_dir: str) -> str:
+    """Point jax's persistent compilation cache at ``cache_dir``.
+
+    Idempotent; returns the absolute directory.  Must run before the
+    programs it should capture compile (the daemon enables it before
+    warmup), but is safe at any time — the cache is consulted per
+    compile, not at backend init.
+    """
+    global _enabled_dir
+    path = os.path.abspath(cache_dir)
+    os.makedirs(path, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", path)
+    # disable the persistence floors: sub-second CPU compiles (the
+    # whole warm-serving CI story) must persist too
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    with _lock:
+        _enabled_dir = path
+    return path
+
+
+def enabled_dir() -> str | None:
+    return _enabled_dir
+
+
+def _programs_path(cache_dir: str | None = None) -> str | None:
+    d = cache_dir or _enabled_dir
+    return None if d is None else os.path.join(d, PROGRAMS_NAME)
+
+
+def _entry_key(entry: dict) -> tuple:
+    return tuple(
+        json.dumps(entry.get(k), sort_keys=True)
+        for k in sorted(entry)
+    )
+
+
+def record_program(entry: dict) -> None:
+    """Append one executed program signature to the sidecar.
+
+    No-op unless :func:`enable` ran.  Deduped in-memory first (the
+    warm path records the same signature once per process at most),
+    then read-merge-replace under ``file_lock`` so N fleet replicas
+    sharing the cache directory never drop each other's entries.
+    Best-effort: any failure is swallowed — persistence must never
+    take down a computed result.
+    """
+    path = _programs_path()
+    if path is None:
+        return
+    key = _entry_key(entry)
+    with _lock:
+        if key in _seen:
+            return
+        _seen.add(key)
+    from repic_tpu.runtime.atomic import file_lock
+
+    try:
+        with file_lock(path):
+            entries = []
+            try:
+                with open(path) as f:
+                    loaded = json.load(f)
+                if isinstance(loaded, list):
+                    entries = [
+                        e for e in loaded if isinstance(e, dict)
+                    ]
+            except (OSError, ValueError):
+                pass
+            entries = [
+                e for e in entries if _entry_key(e) != key
+            ]
+            entries.append(entry)
+            del entries[:-MAX_PROGRAMS]
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "wt") as f:
+                json.dump(entries, f)
+            os.replace(tmp, path)
+    except (OSError, ValueError, TypeError):
+        pass
+
+
+def load_programs(cache_dir: str | None = None) -> list[dict]:
+    """The recorded program signatures (oldest first), or ``[]``.
+
+    Corrupt/missing sidecars read as empty — the cache is an
+    optimization, never a correctness dependency.
+    """
+    path = _programs_path(cache_dir)
+    if path is None:
+        return []
+    try:
+        with open(path) as f:
+            loaded = json.load(f)
+    except (OSError, ValueError):
+        return []
+    if not isinstance(loaded, list):
+        return []
+    return [e for e in loaded if isinstance(e, dict)]

@@ -1,0 +1,842 @@
+"""Continuous cross-request batching: saturate the device.
+
+The PR 8/11 worker runs ONE job at a time: the device idles between
+small jobs (each tiny chunk pays its own dispatch + bookkeeping) and
+a large job head-of-line-blocks everything behind it.  This module is
+the ROADMAP item-1 scheduler: it holds several accepted jobs OPEN at
+once and, at every chunk boundary, coalesces queued micrographs from
+*different* requests into one padded capacity-bucket chunk through
+the pure engine (:mod:`repic_tpu.pipeline.engine`) — the
+dataflow-core / coordination-layer split of the TensorFlow system
+paper (arXiv:1605.08695): the compiled consensus program never knows
+which request a micrograph row belongs to; this layer does.
+
+Scheduling policy (docs/serving.md "Continuous batching"):
+
+* **Coalescing** — jobs group by :class:`CoalesceKey` (the
+  ``RequestPlan.bucket_key`` warm-affinity handle extended with the
+  knobs that must match for rows to share one program: box size,
+  perf flags, device count).  One executed chunk takes micrographs
+  from every open job in the chosen group, so many small jobs clear
+  in one dispatch instead of N.
+* **Fair share** — within a group, chunk slots are dealt round-robin
+  across jobs (rotating first-pick), so small jobs interleave with a
+  large one instead of queueing behind it; across groups, a warm
+  bucket keeps the device at most ``MAX_BUCKET_STREAK`` consecutive
+  chunks while another group waits, the cold-bucket-starvation bound
+  (the analog of the queue's ``MAX_SKIPS``).
+* **Per-request everything** — each job keeps its own run journal
+  (resume semantics), trace artifact (compile/execute segments carry
+  the job's SHARE of each coalesced chunk), deadline/cancel poll at
+  every batch boundary (a cancelled request's remaining micrographs
+  are dropped; the other requests in the batch are untouched), and
+  SLO observation at terminal.
+* **Isolation fallback** — a coalesced chunk that fails for ANY
+  reason returns its micrographs to their jobs and demotes each
+  participant to the battle-tested single-job path
+  (:meth:`ConsensusDaemon._run_job`), whose full retry/degradation
+  ladder isolates the poisoned request; the healthy ones complete.
+
+Batch-occupancy and coalesced-jobs metrics ride on ``/metrics``
+(docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from types import SimpleNamespace
+
+from repic_tpu import telemetry
+from repic_tpu.runtime import faults
+from repic_tpu.runtime.atomic import atomic_write
+from repic_tpu.serve.jobs import (
+    JOB_CANCELLED,
+    JOB_DEADLINE_EXCEEDED,
+    JOB_FAILED,
+    JOB_FINISHED,
+    JOB_QUEUED,
+    Job,
+    crash_point,
+)
+from repic_tpu.telemetry import events as tlm_events
+from repic_tpu.telemetry import probes as tlm_probes
+from repic_tpu.telemetry import server as tlm_server
+from repic_tpu.telemetry import trace as tlm_trace
+
+_log = tlm_events.get_logger("serve.batcher")
+
+_BATCHES = telemetry.counter(
+    "repic_serve_batches_total",
+    "coalesced chunks executed by the continuous batcher",
+)
+_BATCHED_MICS = telemetry.counter(
+    "repic_serve_batched_micrographs_total",
+    "real micrographs executed through coalesced chunks",
+)
+_FALLBACKS = telemetry.counter(
+    "repic_serve_batch_fallbacks_total",
+    "coalesced chunks that failed and demoted their jobs to the "
+    "isolated single-job path",
+)
+_OCCUPANCY = telemetry.histogram(
+    "repic_serve_batch_occupancy",
+    "real-micrograph fraction of each executed coalesced chunk "
+    "(1.0 = no padding waste)",
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+)
+_COALESCED = telemetry.histogram(
+    "repic_serve_coalesced_jobs",
+    "distinct requests contributing micrographs to each executed "
+    "coalesced chunk",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16),
+)
+_OPEN = telemetry.gauge(
+    "repic_serve_open_jobs",
+    "jobs the continuous batcher currently holds open",
+)
+
+
+@dataclass(frozen=True)
+class CoalesceKey:
+    """What must match for two requests' micrographs to share one
+    executed chunk: the warm-affinity ``bucket_key`` (pickers,
+    padded particle capacity, threshold, solver — micrograph count
+    deliberately excluded) plus box size (a runtime input the whole
+    batch shares) and the perf knobs that select the compiled
+    program or its padding arithmetic."""
+
+    bucket_key: tuple
+    box_sizes: tuple
+    max_neighbors: int
+    use_mesh: bool
+    spatial: bool | None
+    use_pallas: bool
+    n_dev: int
+
+    @property
+    def capacity(self) -> int:
+        return self.bucket_key[1]
+
+
+@dataclass
+class OpenJob:
+    """One admitted job's open execution state."""
+
+    job: Job
+    options: object
+    out_dir: str
+    box_size: object
+    key: CoalesceKey | None
+    journal: object                 # per-job RunJournal
+    rt: object                      # per-job telemetry run handle
+    tctx: object                    # per-request TraceContext
+    names: list
+    already: set
+    n_dev: int
+    num_pickers: int
+    t0: float                       # daemon clock at pick
+    cancel: object                  # the chunk-boundary cancel hook
+    pending: list = field(default_factory=list)
+    counts: dict = field(default_factory=dict)
+    quarantined: dict = field(default_factory=dict)
+    outcomes: object = None
+    chunk_i: int = 0
+
+    def sink(self, fname: str, content: str) -> None:
+        with atomic_write(os.path.join(self.out_dir, fname)) as f:
+            f.write(content)
+
+
+class ContinuousBatcher:
+    """The serve worker's batch-mode scheduler loop."""
+
+    #: consecutive chunks one coalesce group may keep the device
+    #: while another group has work waiting
+    MAX_BUCKET_STREAK = 4
+    #: coalesced chunks pad their micrograph axis up to this grid
+    #: minimum so small chunks of different sizes land on one
+    #: compiled shape (bucket_key must not fragment the program
+    #: cache across jobs differing only in micrograph count)
+    MIN_CHUNK_PAD = 4
+
+    def __init__(self, daemon, max_open: int = 4):
+        if max_open < 1:
+            raise ValueError("max_open must be >= 1")
+        self.daemon = daemon
+        self.queue = daemon.queue
+        self.max_open = max_open
+        self._open: list[OpenJob] = []
+        self._last_key: CoalesceKey | None = None
+        self._last_capacity: int | None = None
+        self._streak = 0
+        self._rr = -1  # first deal starts at the oldest open job
+
+    # -- the loop -----------------------------------------------------
+
+    def run(self) -> None:
+        while True:
+            try:
+                self._admit()
+                if not self._open:
+                    if self.queue.draining:
+                        return
+                    continue
+                self._poll_boundaries()
+                self._finish_completed()
+                sel = self._select()
+                if sel:
+                    self._execute(sel)
+                    self._poll_boundaries()
+                    self._finish_completed()
+                self.daemon.publish_status()
+            except Exception as e:  # noqa: BLE001 - last resort
+                # nothing may kill the sole worker behind a live
+                # front end; fail whatever was open (visible to its
+                # client, counted by the breaker) and keep serving
+                _log.error(f"batch scheduler error: {e}")
+                for oj in list(self._open):
+                    self._fail(oj, e)
+                time.sleep(0.05)
+
+    def status(self) -> dict:
+        """The /status ``scheduler`` section."""
+        return {
+            "mode": "batch",
+            "max_open": self.max_open,
+            "open_jobs": len(self._open),
+            "open_micrographs": sum(
+                len(oj.pending) for oj in self._open
+            ),
+            "warm_capacity": self._last_capacity,
+        }
+
+    # -- admission into the open set ----------------------------------
+
+    def _admit(self) -> None:
+        while len(self._open) < self.max_open:
+            job = self.queue.next_job(
+                0.0 if self._open else 0.2, self._last_capacity
+            )
+            if job is None:
+                break
+            oj = self._open_job(job)
+            if oj is not None:
+                self._open.append(oj)
+            _OPEN.set(len(self._open))
+
+    def _open_job(self, job: Job) -> OpenJob | None:
+        daemon = self.daemon
+        try:
+            self.queue.mark_running(job)
+        except Exception as e:  # noqa: BLE001 - journal may be down
+            return self._fail_bare(job, e)
+        t_picked = time.time()
+        daemon.publish_status()
+        queue_wait = max(
+            (job.started_ts or job.accepted_ts) - job.accepted_ts,
+            0.0,
+        )
+        tlm_server.observe_slo("queue_wait", queue_wait)
+        out_dir = daemon.job_dir(job.id)
+        os.makedirs(out_dir, exist_ok=True)
+        replica = daemon.fleet.replica if daemon.fleet else None
+        tctx = tlm_trace.start(
+            out_dir,
+            trace_id=job.trace_id,
+            host=replica,
+            kind="serve",
+            job=job.id,
+            accepted_ts=round(job.accepted_ts, 6),
+        )
+        job.trace_id = tctx.trace_id
+        token = tlm_trace.activate(tctx)
+        try:
+            tlm_trace.add_segment(
+                "queue_wait", job.accepted_ts, queue_wait
+            )
+            return self._open_job_traced(
+                job, out_dir, tctx, t_picked, replica
+            )
+        except Exception as e:  # noqa: BLE001 - isolation boundary
+            tctx.close()
+            return self._fail_bare(job, e)
+        finally:
+            tlm_trace.deactivate(token)
+
+    def _open_job_traced(
+        self, job, out_dir, tctx, t_picked, replica
+    ) -> OpenJob | None:
+        import numpy as np
+
+        from repic_tpu.pipeline import engine
+        from repic_tpu.runtime.journal import RunJournal, error_info
+        from repic_tpu.runtime.ladder import ChunkOutcomes
+        from repic_tpu.utils import box_io
+
+        daemon = self.daemon
+        crash_point(f"run:{job.id}")
+        if daemon.fleet is not None:
+            from repic_tpu.serve import fleet as fleet_mod
+
+            fleet_mod.crash_point(replica, f"run:{job.id}")
+        t0 = daemon._clock()
+        if (
+            job.deadline_ts is not None
+            and daemon._clock() > job.deadline_ts
+        ):
+            job.reason = "deadline exceeded while queued"
+            daemon._finish_job(
+                job, JOB_DEADLINE_EXCEEDED, reason=job.reason
+            )
+            tctx.close()
+            return None
+        options = engine.ConsensusOptions.from_dict(
+            job.request.get("options") or {}
+        )
+        in_dir = job.request["in_dir"]
+        box_size = job.request["box_size"]
+        pickers = box_io.discover_picker_dirs(in_dir)
+        if not pickers:
+            raise ValueError(f"no picker subdirectories in {in_dir}")
+        names = box_io.micrograph_names(
+            os.path.join(in_dir, pickers[0])
+        )
+        run_config = {
+            "in_dir": in_dir,
+            "box_size": np.asarray(box_size).tolist(),
+            "threshold": options.threshold,
+            "num_particles": options.num_particles,
+            "solver": options.solver,
+            "pickers": pickers,
+            "names": names,
+        }
+        journal = RunJournal.open(
+            out_dir,
+            run_config,
+            resume=True,
+            host=replica,
+            cluster=replica is not None,
+        )
+        # the run scope is deliberately CROSS-FUNCTION: it stays
+        # open while the job is open (chunks from many scheduler
+        # passes write into it) and every exit path — _finalize,
+        # _close via _cancelled/_fallback/_fail, and the except
+        # below — calls finish_run exactly once
+        rt = telemetry.start_run(  # repic: noqa[RT202]
+            out_dir,
+            run_id=f"serve-{job.id}",
+            host=replica,
+        )
+        try:
+            already = set()
+            if journal.resumed:
+                latest = journal.latest()
+                for nm in journal.done_names():
+                    out_name = latest[nm].get("out", nm + ".box")
+                    if os.path.exists(
+                        os.path.join(out_dir, out_name)
+                    ):
+                        already.add(nm)
+            counts: dict = {}
+            quarantined: dict = {}
+            loaded = []
+            for nm in names:
+                if nm in already:
+                    continue
+                try:
+                    sets = box_io.load_micrograph_set(
+                        in_dir, pickers, nm
+                    )
+                except (box_io.BoxParseError, OSError) as e:
+                    if options.strict:
+                        raise
+                    info = error_info(
+                        e, path=getattr(e, "path", None)
+                    )
+                    quarantined[nm] = info
+                    journal.record(
+                        nm, "quarantined", error=info, stage="load"
+                    )
+                    continue
+                if sets is None:
+                    box_io.write_empty_box(
+                        os.path.join(out_dir, nm + ".box")
+                    )
+                    journal.record(nm, "skipped", out=nm + ".box")
+                    counts[nm] = 0
+                    continue
+                loaded.append((nm, sets))
+            n_dev = 1
+            if options.use_mesh:
+                import jax
+
+                n_dev = len(jax.devices())
+            key = None
+            if loaded:
+                plan = engine.plan_request(
+                    loaded, box_size, options, n_dev=n_dev
+                )
+                key = CoalesceKey(
+                    bucket_key=plan.bucket_key,
+                    box_sizes=tuple(
+                        np.asarray(box_size, np.float32)
+                        .reshape(-1)
+                        .tolist()
+                    )
+                    if np.asarray(box_size).ndim
+                    else (float(box_size),),
+                    max_neighbors=options.max_neighbors,
+                    use_mesh=options.use_mesh,
+                    spatial=options.spatial,
+                    use_pallas=options.use_pallas,
+                    n_dev=n_dev,
+                )
+                job.progress = {
+                    "chunks_total": len(plan.chunks),
+                    "chunks_done": 0,
+                    "capacity": plan.capacity,
+                    "micrographs_total": len(names),
+                    "micrographs_done": len(already) + len(counts),
+                }
+                tlm_trace.add_segment(
+                    "plan", t_picked, time.time() - t_picked,
+                    micrographs=len(names),
+                    chunks=len(plan.chunks),
+                    capacity=plan.capacity,
+                )
+            oj = OpenJob(
+                job=job,
+                options=options,
+                out_dir=out_dir,
+                box_size=box_size,
+                key=key,
+                journal=journal,
+                rt=rt,
+                tctx=tctx,
+                names=names,
+                already=already,
+                n_dev=n_dev,
+                num_pickers=len(pickers),
+                t0=t0,
+                cancel=daemon._cancel_check(job),
+                pending=loaded,
+                counts=counts,
+                quarantined=quarantined,
+                outcomes=ChunkOutcomes(),
+            )
+            return oj
+        except Exception:
+            journal.close()
+            telemetry.finish_run(rt)
+            raise
+
+    # -- scheduling ---------------------------------------------------
+
+    def _select(self):
+        """Pick a coalesce group (warm streak, bounded) and deal its
+        chunk slots round-robin across the group's jobs.  Returns
+        ``[(open_job, [(name, sets), ...]), ...]`` with each job's
+        share CONTIGUOUS (the executed batch's row layout), or None.
+        """
+        from repic_tpu.pipeline.engine import _auto_chunk
+
+        groups: dict[CoalesceKey, list[OpenJob]] = {}
+        for oj in self._open:
+            if oj.pending and oj.key is not None:
+                groups.setdefault(oj.key, []).append(oj)
+        if not groups:
+            return None
+        if len(groups) == 1:
+            key = next(iter(groups))
+            self._streak = self._streak + 1 if (
+                key == self._last_key
+            ) else 0
+        elif (
+            self._last_key in groups
+            and self._streak < self.MAX_BUCKET_STREAK
+        ):
+            key = self._last_key
+            self._streak += 1
+        else:
+            # longest-waiting other group runs next; streak resets
+            key = min(
+                (k for k in groups if k != self._last_key),
+                key=lambda k: min(
+                    oj.job.accepted_ts for oj in groups[k]
+                ),
+            )
+            self._streak = 0
+        self._last_key = key
+        self._last_capacity = key.capacity
+        jobs = groups[key]
+        total = sum(len(oj.pending) for oj in jobs)
+        target = _auto_chunk(
+            total, jobs[0].num_pickers, key.capacity, key.n_dev
+        )
+        # deal onto the shape ladder: either fill (>= 3/4) the next
+        # ladder size up, or deal the ladder size below in full —
+        # so arrival-pattern noise can never mint a new chunk shape
+        # (every distinct shape is a full XLA compile) and padding
+        # waste stays bounded at 1/4 of a chunk.  The PADDED size
+        # must respect the memory-budget cap too: stepping up to
+        # ``hi`` is only allowed when ``hi`` itself fits the cap
+        # (a target of 8 dealt in full would pad to 16 — twice the
+        # budget); otherwise deal the ladder size below, whose pad
+        # is itself (the MIN_CHUNK_PAD floor is the one deliberate
+        # exception, documented on _padded_micrographs)
+        avail = min(total, target)
+        lo, hi = self._ladder_around(avail)
+        if hi <= target and avail >= max((3 * hi) // 4, lo + 1):
+            target = min(avail, hi)
+        else:
+            target = min(avail, lo)
+        # fair share: deal slots one per job per round (rotating who
+        # picks first), so a burst of small jobs rides along with a
+        # large one instead of queueing behind it
+        self._rr += 1
+        start = self._rr % len(jobs)
+        order = jobs[start:] + jobs[:start]
+        alloc = {id(oj): 0 for oj in order}
+        dealt = 0
+        while dealt < target:
+            progressed = False
+            for oj in order:
+                if dealt >= target:
+                    break
+                if alloc[id(oj)] < len(oj.pending):
+                    alloc[id(oj)] += 1
+                    dealt += 1
+                    progressed = True
+            if not progressed:
+                break
+        parts = []
+        for oj in order:
+            n = alloc[id(oj)]
+            if n:
+                parts.append((oj, oj.pending[:n]))
+                del oj.pending[:n]
+        return parts or None
+
+    def _ladder_around(self, m: int) -> tuple:
+        """The chunk-shape ladder values bracketing ``m``: powers of
+        4 from ``MIN_CHUNK_PAD`` (4, 16, 64, ...).  Deliberately
+        SPARSE — the micrograph axis takes whatever the deal
+        produced, and on a fine grid every open-job mix would mint
+        its own shape, each a full XLA compile of the heaviest
+        program in the system.  Two-ish shapes per capacity bucket
+        is the whole point: a cold daemon facing a mixed small-job
+        burst compiles ~2 programs where the single-job scheduler
+        compiles one PER JOB SIZE (the bench_serve.py headline)."""
+        lo = self.MIN_CHUNK_PAD
+        while lo * 4 <= m:
+            lo *= 4
+        return lo, lo * 4
+
+    def _padded_micrographs(self, m_real: int, key: CoalesceKey):
+        """Pad the dealt chunk up to its ladder shape (and to a
+        mesh-axis multiple)."""
+        b = self.MIN_CHUNK_PAD
+        while b < m_real:
+            b *= 4
+        return -(-b // key.n_dev) * key.n_dev
+
+    # -- execution ----------------------------------------------------
+
+    def _execute(self, parts) -> None:
+        from repic_tpu.parallel.batching import pad_batch
+        from repic_tpu.pipeline import engine
+        from repic_tpu.pipeline.consensus import run_consensus_batch
+
+        key = parts[0][0].key
+        flat = [item for _, items in parts for item in items]
+        m_real = len(flat)
+        m_pad = self._padded_micrographs(m_real, key)
+        opt = parts[0][0].options
+        box_size = parts[0][0].box_size
+        hits_c = telemetry.counter("repic_program_cache_hits_total")
+        miss_c = telemetry.counter(
+            "repic_program_cache_misses_total"
+        )
+        t_mark = time.time()
+        comp_mark = tlm_probes.compile_seconds()
+        hits_mark = hits_c.value()
+        miss_mark = miss_c.value()
+        ckey = f"chunk:{flat[0][0]}:{m_real}"
+        try:
+            batch = pad_batch(
+                flat,
+                pad_micrographs_to=m_pad,
+                capacity=key.capacity,
+            )
+            # the chunk's spans (consensus_chunk + the PR 7
+            # consensus_dispatch inside) carry the LEAD participant's
+            # trace id — one span cannot split across requests, so
+            # the oldest job in the deal owns it; its per-job share
+            # attribution happens at the trace-segment layer below
+            lead = tlm_trace.activate(parts[0][0].tctx)
+            try:
+                with tlm_events.span(
+                    "consensus_chunk",
+                    micrographs=m_real,
+                    capacity=key.capacity,
+                    coalesced_jobs=len(parts),
+                ):
+                    faults.inject("oom", ckey)
+                    faults.inject("io", ckey)
+                    _res, packed = run_consensus_batch(
+                        batch,
+                        box_size,
+                        threshold=opt.threshold,
+                        max_neighbors=opt.max_neighbors,
+                        use_mesh=opt.use_mesh,
+                        spatial=opt.spatial,
+                        solver=opt.solver,
+                        use_pallas=opt.use_pallas,
+                        packed_probe=True,
+                    )
+            finally:
+                tlm_trace.deactivate(lead)
+        except Exception as e:  # noqa: BLE001 — isolation fallback
+            self._fallback(parts, e)
+            return
+        now = time.time()
+        chunk_s = max(now - t_mark, 0.0)
+        compile_s = min(
+            max(tlm_probes.compile_seconds() - comp_mark, 0.0),
+            chunk_s,
+        )
+        hits_d = int(hits_c.value() - hits_mark)
+        miss_d = int(miss_c.value() - miss_mark)
+        _BATCHES.inc()
+        _BATCHED_MICS.inc(m_real)
+        _OCCUPANCY.observe(m_real / max(batch.xy.shape[0], 1))
+        _COALESCED.observe(len(parts))
+        row = 0
+        replica = (
+            self.daemon.fleet.replica if self.daemon.fleet else None
+        )
+        for oj, items in parts:
+            rows = packed[row : row + len(items)]
+            row += len(items)
+            share = len(items) / m_real
+            token = tlm_trace.activate(oj.tctx)
+            try:
+                # compile gates every participant (it is genuinely
+                # shared), so each gets the full segment with the
+                # cache-counter deltas — "was I served warm" stays
+                # answerable per request; execute carries the job's
+                # SHARE of the chunk (micrograph-proportional)
+                if (
+                    oj.chunk_i == 0
+                    or compile_s > 0.0
+                    or hits_d
+                    or miss_d
+                ):
+                    tlm_trace.add_segment(
+                        "compile", now - chunk_s, compile_s,
+                        chunk=oj.chunk_i,
+                        cache_hits=hits_d,
+                        cache_misses=miss_d,
+                        coalesced_jobs=len(parts),
+                    )
+                tlm_trace.add_segment(
+                    "execute",
+                    now - chunk_s + compile_s,
+                    max(chunk_s - compile_s, 0.0) * share,
+                    chunk=oj.chunk_i,
+                    micrographs=len(items),
+                    capacity=key.capacity,
+                    coalesced_jobs=len(parts),
+                    share=round(share, 4),
+                )
+                with tlm_trace.segment(
+                    "emit", chunk=oj.chunk_i,
+                    micrographs=len(items),
+                ):
+                    sub = SimpleNamespace(
+                        names=tuple(nm for nm, _ in items)
+                    )
+                    oj.counts.update(
+                        engine.emit_box_chunk(
+                            sub, rows, oj.box_size,
+                            num_particles=oj.options.num_particles,
+                            sink=oj.sink,
+                        )
+                    )
+                    for nm, _sets in items:
+                        oj.journal.record(
+                            nm,
+                            oj.outcomes.status.get(nm, "ok"),
+                            wall_s=round(
+                                chunk_s / max(m_real, 1), 6
+                            ),
+                            solver=oj.options.solver,
+                            particles=oj.counts.get(nm),
+                            out=nm + ".box",
+                        )
+                    oj.job.progress["chunks_done"] = oj.chunk_i + 1
+                    oj.job.progress["micrographs_done"] = (
+                        len(oj.already) + len(oj.counts)
+                    )
+                    # no per-chunk flush_run here: a coalesced chunk
+                    # touches up to max_open jobs and each flush is
+                    # two atomic file writes — the background
+                    # flusher (REPIC_TPU_FLUSH_S) keeps mid-job
+                    # sinks fresh, finish_run writes the final ones
+            finally:
+                tlm_trace.deactivate(token)
+            crash_point(f"run:{oj.job.id}:chunk:{oj.chunk_i}")
+            if self.daemon.fleet is not None:
+                from repic_tpu.serve import fleet as fleet_mod
+
+                fleet_mod.crash_point(
+                    replica, f"chunk:{oj.job.id}:{oj.chunk_i}"
+                )
+            oj.chunk_i += 1
+
+    def _fallback(self, parts, exc: BaseException) -> None:
+        """A failed coalesced chunk demotes every participant to the
+        single-job path: micrographs already emitted stay on disk
+        (journaled), so the solo re-run RESUMES rather than redoes —
+        and its full ladder isolates whichever request poisoned the
+        batch while the healthy ones complete."""
+        _FALLBACKS.inc()
+        _log.info(
+            f"coalesced chunk failed ({exc}); demoting "
+            f"{len(parts)} job(s) to the single-job path"
+        )
+        for oj, items in parts:
+            oj.pending[:0] = items  # hand back, order preserved
+        for oj, _items in parts:
+            oj.journal.record_event(
+                "coalesce_fallback", error=str(exc)[:200]
+            )
+            self._close(oj)
+            try:
+                self.daemon._run_job(oj.job)
+            except Exception as e:  # noqa: BLE001 - last resort
+                self._fail_bare(oj.job, e)
+            self.daemon.publish_status()
+
+    # -- boundaries ---------------------------------------------------
+
+    def _poll_boundaries(self) -> None:
+        for oj in list(self._open):
+            try:
+                reason = oj.cancel()
+            except Exception:  # noqa: BLE001 - poll never kills
+                continue
+            if reason:
+                self._cancelled(oj, reason)
+
+    def _cancelled(self, oj: OpenJob, reason) -> None:
+        job = oj.job
+        reason = reason if isinstance(reason, str) else "cancelled"
+        job.reason = reason
+        try:
+            if reason.startswith("fenced"):
+                # a survivor owns the job now: stop without a
+                # terminal record — the winner's commit is the one
+                self.queue.abandon(job)
+                self._close(oj)
+                return
+            if reason.startswith("deadline"):
+                state = JOB_DEADLINE_EXCEEDED
+            elif reason.startswith("draining"):
+                # back to queued, journaled for the next generation
+                state = JOB_QUEUED
+            else:
+                state = JOB_CANCELLED
+            self.daemon._finish_job(job, state, reason=reason)
+            self._close(oj)
+        except Exception as e:  # noqa: BLE001 - last resort
+            self._fail(oj, e)
+
+    def _finish_completed(self) -> None:
+        for oj in list(self._open):
+            if oj.pending:
+                continue
+            try:
+                self._finalize(oj)
+            except Exception as e:  # noqa: BLE001 - last resort
+                self._fail(oj, e)
+
+    def _finalize(self, oj: OpenJob) -> None:
+        from repic_tpu.serve.daemon import _JOB_SECONDS
+
+        daemon = self.daemon
+        job = oj.job
+        t_finish0 = time.time()
+        quarantined = dict(oj.quarantined)
+        quarantined.update(oj.outcomes.quarantined)
+        job.result = {
+            "micrographs": len(oj.names),
+            "resumed_micrographs": len(oj.already),
+            "particles": int(sum(oj.counts.values())),
+            "quarantined": len(quarantined),
+            "out_dir": oj.out_dir,
+            "journal": oj.journal.summary(),
+        }
+        oj.journal.close()
+        crash_point(f"finish:{job.id}")
+        token = tlm_trace.activate(oj.tctx)
+        try:
+            tlm_trace.add_segment(
+                "finish", t_finish0, time.time() - t_finish0
+            )
+        finally:
+            tlm_trace.deactivate(token)
+        # terminal record FIRST, sink/trace teardown after: the
+        # teardown writes files, and milliseconds of it inside the
+        # accept->finished_ts wall would break the segment-sum ~=
+        # wall contract for warm sub-100ms jobs
+        wall = daemon._clock() - oj.t0
+        _JOB_SECONDS.observe(
+            wall,
+            bucket=str(job.progress.get("capacity", "none")),
+        )
+        daemon._finish_job(
+            job, JOB_FINISHED,
+            wall_s=round(wall, 3),
+            particles=job.result["particles"],
+            quarantined=job.result["quarantined"],
+        )
+        self.queue.breaker.record_success()
+        self._drop(oj)
+        telemetry.finish_run(oj.rt)
+        oj.tctx.close()
+
+    # -- cleanup / failure --------------------------------------------
+
+    def _drop(self, oj: OpenJob) -> None:
+        if oj in self._open:
+            self._open.remove(oj)
+        _OPEN.set(len(self._open))
+
+    def _close(self, oj: OpenJob) -> None:
+        self._drop(oj)
+        try:
+            oj.journal.close()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+        telemetry.finish_run(oj.rt)
+        oj.tctx.close()
+
+    def _fail_bare(self, job: Job, exc: BaseException) -> None:
+        """The worker-loop last-resort shape: the job FAILS (visible
+        to its client, counted by the breaker and the SLO plane) and
+        the scheduler keeps running."""
+        try:
+            job.error = self.queue.error_doc(exc)
+            self.daemon._finish_job(job, JOB_FAILED, error=job.error)
+        except Exception:  # noqa: BLE001 - the journal may be down
+            self.queue.mark_failed(job)
+        self.queue.breaker.record_failure()
+        _log.error(f"job {job.id} failed: {exc}")
+        return None
+
+    def _fail(self, oj: OpenJob, exc: BaseException) -> None:
+        self._close(oj)
+        self._fail_bare(oj.job, exc)

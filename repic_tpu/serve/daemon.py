@@ -77,6 +77,25 @@ MAX_IN_DIR = 4096
 MAX_BOX_SIZES = 64
 
 
+def estimate_micrographs(request: dict) -> int | None:
+    """Admission-time micrograph count for the validated request —
+    the unit the 429 ``Retry-After`` estimate is priced in (queued
+    MICROGRAPHS x per-micrograph service time, not whole jobs).
+    One directory listing; best-effort (None when unreadable)."""
+    try:
+        from repic_tpu.utils import box_io
+
+        in_dir = request["in_dir"]
+        pickers = box_io.discover_picker_dirs(in_dir)
+        if not pickers:
+            return None
+        return len(
+            box_io.micrograph_names(os.path.join(in_dir, pickers[0]))
+        )
+    except Exception:  # noqa: BLE001 - estimate only, never a 5xx
+        return None
+
+
 def validate_submission(body: bytes):
     """Parse + validate a POST /v1/jobs body.
 
@@ -237,6 +256,10 @@ class ServeServer(tlm_server.StatusServer):
                 deadline_s=deadline_s,
                 bucket_hint=hint,
                 idempotency_key=idempotency_key,
+                # lazy: the queue resolves this only past the
+                # draining/breaker rejections — load shedding must
+                # not pay directory listings per refused request
+                micrographs=lambda: estimate_micrographs(request),
             )
         except AdmissionError as e:
             self._json(
@@ -339,12 +362,33 @@ class ConsensusDaemon:
         replica_id: str | None = None,
         heartbeat_interval_s: float = 2.0,
         replica_timeout_s: float = 10.0,
+        scheduler: str = "batch",
+        max_open: int = 4,
+        compile_cache: str | None = None,
+        warmup_buckets: list | None = None,
         clock=time.time,
     ):
+        if scheduler not in ("batch", "single"):
+            raise ValueError(
+                f"scheduler must be 'batch' or 'single', "
+                f"got {scheduler!r}"
+            )
+        if int(max_open) < 1:
+            # validated HERE, not first inside the worker thread: a
+            # worker that dies after readiness goes green leaves a
+            # live front end 202-ing jobs into a queue nothing
+            # drains
+            raise ValueError(
+                f"max_open must be >= 1, got {max_open}"
+            )
         self.work_dir = os.path.abspath(work_dir)
         self.default_deadline_s = default_deadline_s
         self.drain_grace_s = drain_grace_s
         self.do_warmup = warmup
+        self.scheduler = scheduler
+        self.max_open = int(max_open)
+        self.warmup_bucket_list = list(warmup_buckets or ())
+        self.batcher = None
         self._clock = clock
         # rolling SLO view for /status (always on — without
         # --slo-target objectives it still reports p50/p95/p99)
@@ -385,6 +429,26 @@ class ConsensusDaemon:
                 queue_limit, self.journal, breaker, clock=clock
             )
         self.server = ServeServer(self, port, host)
+        # persistent compile cache (docs/serving.md "Compile cache
+        # as a deploy artifact"): "auto" points it inside the fleet
+        # dir (shared — a replacement replica starts warm) or the
+        # work dir; None (the direct-construction default, so unit
+        # tests never mutate process-wide jax config) disables it
+        self.compile_cache_dir = None
+        if compile_cache is not None:
+            from repic_tpu.runtime import compilecache
+
+            root = (
+                self.fleet.fleet_dir
+                if self.fleet is not None
+                else self.work_dir
+            )
+            self.compile_cache_dir = compilecache.resolve_dir(
+                None if compile_cache == "auto" else compile_cache,
+                os.path.join(root, "_compile_cache"),
+            )
+            if self.compile_cache_dir is not None:
+                compilecache.enable(self.compile_cache_dir)
         self._stop = threading.Event()
         self._drain_deadline: float | None = None
         self._worker: threading.Thread | None = None
@@ -507,6 +571,11 @@ class ConsensusDaemon:
             # count + cooldown) — a tripped breaker must be readable
             # off /status, not inferred from 503s
             breaker=self.queue.breaker.describe(),
+            scheduler=(
+                self.batcher.status()
+                if self.batcher is not None
+                else {"mode": self.scheduler}
+            ),
         )
         if self.fleet is not None:
             fields["fleet"] = self.queue.fleet_status()
@@ -514,24 +583,48 @@ class ConsensusDaemon:
 
     # -- worker -------------------------------------------------------
 
+    def _warmup(self) -> None:
+        """The readiness-gating ahead-of-time compile sequence:
+        the probe program, every declared ``--warmup-bucket``, and —
+        with the persistent compile cache enabled — an exact replay
+        of every recorded program signature, each loaded from the
+        on-disk XLA cache in milliseconds, so the first request on
+        any previously-seen capacity bucket is served warm."""
+        try:
+            from repic_tpu.pipeline import engine
+
+            info = engine.warmup()
+            if self.warmup_bucket_list:
+                info["buckets"] = engine.warmup_buckets(
+                    self.warmup_bucket_list
+                )
+            if self.compile_cache_dir is not None:
+                info.update(engine.warmup_from_cache())
+                info["compile_cache"] = self.compile_cache_dir
+            self.journal.record_event("warmup", **info)
+            tlm_server.set_ready(True)
+        except Exception as e:  # noqa: BLE001 - stay alive
+            # liveness stays green (the operator can reach
+            # /status); readiness stays red — the standard
+            # "up but unservable" posture
+            self.journal.record_event(
+                "warmup_failed", error=self.queue.error_doc(e)
+            )
+            _log.error(f"warmup failed: {e}")
+
     def _worker_loop(self) -> None:
         if self.do_warmup:
-            try:
-                from repic_tpu.pipeline import engine
-
-                info = engine.warmup()
-                self.journal.record_event("warmup", **info)
-                tlm_server.set_ready(True)
-            except Exception as e:  # noqa: BLE001 - stay alive
-                # liveness stays green (the operator can reach
-                # /status); readiness stays red — the standard
-                # "up but unservable" posture
-                self.journal.record_event(
-                    "warmup_failed", error=self.queue.error_doc(e)
-                )
-                _log.error(f"warmup failed: {e}")
+            self._warmup()
         else:
             tlm_server.set_ready(True)
+        if self.scheduler == "batch":
+            from repic_tpu.serve.batcher import ContinuousBatcher
+
+            self.batcher = ContinuousBatcher(
+                self, max_open=self.max_open
+            )
+            self.batcher.run()
+            return
         last_bucket = None
         while True:
             job = self.queue.next_job(0.2, last_bucket)
@@ -557,7 +650,7 @@ class ConsensusDaemon:
                         job, JOB_FAILED, error=job.error
                     )
                 except Exception:  # the journal itself may be down
-                    job.state = JOB_FAILED
+                    self.queue.mark_failed(job)
                 self.queue.breaker.record_failure()
                 _log.error(f"worker error on job {job.id}: {e}")
             self.publish_status()
@@ -797,7 +890,12 @@ class ConsensusDaemon:
                 plan = engine.plan_request(
                     loaded, box_size, options, n_dev=n_dev
                 )
-                bucket = plan.bucket_key
+                # the warm-affinity handle handed back to next_job
+                # must be the CAPACITY int (what clients declare as
+                # bucket_hint) — the full bucket_key tuple would
+                # never compare equal to a hint and silently turn
+                # affinity scheduling into pure FIFO
+                bucket = plan.capacity
                 job.progress = {
                     "chunks_total": len(plan.chunks),
                     "chunks_done": 0,

@@ -75,6 +75,8 @@ from repic_tpu.runtime.journal import (
 from repic_tpu.runtime.ladder import HOST_LIVE
 from repic_tpu.serve.jobs import (
     JOB_CANCELLED,
+    JOB_FAILED,
+    JOB_FINISHED,
     JOB_QUEUED,
     JOB_RUNNING,
     SERVE_JOURNAL_NAME,
@@ -474,9 +476,12 @@ class FleetQueue:
         self._jobs: dict[str, Job] = {}   # jobs this replica touched
         self._terminal: list[str] = []
         self._idemp: dict[str, str] = {}
-        self._running: str | None = None
+        # several leases may be held open at once (the continuous
+        # batcher coalesces jobs), so "running" is a set
+        self._running: set[str] = set()
         self.draining = False
-        self._avg_job_s = 10.0
+        # decayed per-micrograph service time (Retry-After unit)
+        self._avg_mic_s = 2.0
         self._reader = MergedJournalReader(
             member.fleet_dir, base_name=SERVE_JOURNAL_NAME
         )
@@ -564,6 +569,7 @@ class FleetQueue:
             replica=latest.get("replica"),
             deadline_ts=first.get("deadline_ts"),
             bucket_hint=first.get("bucket_hint"),
+            micrographs=first.get("micrographs"),
             resumed=bool(latest.get("resumed", False)),
             cancel_requested=info["cancel_requested"],
         )
@@ -591,12 +597,13 @@ class FleetQueue:
     # -- admission ----------------------------------------------------
 
     def submit(self, request, *, deadline_s=None, bucket_hint=None,
-               idempotency_key=None) -> Job:
+               idempotency_key=None, micrographs=None) -> Job:
         return self.submit_idempotent(
             request,
             deadline_s=deadline_s,
             bucket_hint=bucket_hint,
             idempotency_key=idempotency_key,
+            micrographs=micrographs,
         )[0]
 
     def submit_idempotent(
@@ -606,6 +613,7 @@ class FleetQueue:
         deadline_s: float | None = None,
         bucket_hint: int | None = None,
         idempotency_key: str | None = None,
+        micrographs: int | None = None,
     ) -> tuple[Job, bool]:
         """Admit one request (or dedupe a retry) fleet-wide.
 
@@ -651,7 +659,11 @@ class FleetQueue:
                 outcome="rejected", cause="circuit_open", code="503"
             )
             raise
-        depth = self._fleet_depth(self.fleet_view())
+        if callable(micrographs):
+            # resolved after the cheap rejections (JobQueue contract)
+            micrographs = micrographs()
+        view = self.fleet_view()
+        depth = self._fleet_depth(view)
         live = self.member.live_replicas()
         stormed = faults.check("request_storm", "submit")
         if depth >= self.limit or stormed:
@@ -659,12 +671,22 @@ class FleetQueue:
             _ADMISSION.inc(
                 outcome="rejected", cause="queue_full", code="429"
             )
-            # fleet-aware backoff: the shared backlog drains at the
-            # rate of every LIVE replica, not just this one
+            # fleet-aware backoff in MICROGRAPHS: per-micrograph
+            # service time x fleet-wide queued micrographs (each
+            # queued record carries its admission-time estimate),
+            # drained at the rate of every LIVE replica — whole-job
+            # averages over-estimated under continuous batching
+            mics = sum(
+                (info["first"].get("micrographs") or 1)
+                for jid, info in view.items()
+                if info["state"] == JOB_QUEUED
+                and self._is_open(jid, info)
+                and self.member.lease_info(jid) is None
+            )
             raise AdmissionError(
                 429,
                 "queue_full",
-                self._avg_job_s * max(depth, 1) / live,
+                self._avg_mic_s * max(mics, depth, 1) / live,
             )
         with self._lock:
             # re-check under the creation lock: two concurrent
@@ -690,12 +712,15 @@ class FleetQueue:
                     else None
                 ),
                 bucket_hint=bucket_hint,
+                micrographs=micrographs,
             )
             extra = (
                 {"idempotency_key": idempotency_key}
                 if idempotency_key
                 else {}
             )
+            if micrographs is not None:
+                extra["micrographs"] = micrographs
             # journal-before-202 (under the lock, like JobQueue):
             # the accepting replica's flushed record IS the durable
             # enqueue every peer can see and claim
@@ -803,9 +828,9 @@ class FleetQueue:
         """A job this replica already holds the lease for but is not
         running (restart recovery, or a freshly stolen lease)."""
         with self._lock:
-            running = self._running
+            running = set(self._running)
         for jid, info in view.items():
-            if jid == running or not self._is_open(jid, info):
+            if jid in running or not self._is_open(jid, info):
                 continue
             lease = self.member.lease_info(jid)
             if lease is None or lease.get("replica") != (
@@ -830,7 +855,7 @@ class FleetQueue:
                 resumed = info["state"] == JOB_RUNNING
             job.resumed = bool(job.resumed or resumed)
             job.replica = self.member.replica
-            self._running = jid
+            self._running.add(jid)
         return job
 
     def _affinity_order(self, claimable, last_bucket):
@@ -853,15 +878,27 @@ class FleetQueue:
                 break
         return ordered
 
+    def mark_failed(self, job: Job) -> None:
+        """Last-resort state flip when :meth:`finish` itself failed
+        (journal down): mirror of JobQueue.mark_failed."""
+        with self._lock:
+            self._running.discard(job.id)
+            job.state = JOB_FAILED
+
     def mark_running(self, job: Job) -> None:
         from repic_tpu.serve.jobs import _QUEUE_WAIT
 
         with self._lock:
+            # same-process re-run (batcher fallback): keep the
+            # original started_ts, no second queue-wait observation
+            rerun = job.started_ts is not None
             job.state = JOB_RUNNING
-            job.started_ts = self._clock()
-        _QUEUE_WAIT.observe(
-            max(job.started_ts - job.accepted_ts, 0.0)
-        )
+            if not rerun:
+                job.started_ts = self._clock()
+        if not rerun:
+            _QUEUE_WAIT.observe(
+                max(job.started_ts - job.accepted_ts, 0.0)
+            )
         self.journal.record(
             job.id, JOB_RUNNING, resumed=job.resumed,
             trace=job.trace_id,
@@ -874,8 +911,7 @@ class FleetQueue:
         from repic_tpu.serve.jobs import _JOBS
 
         with self._lock:
-            if self._running == job.id:
-                self._running = None
+            self._running.discard(job.id)
         if state not in TERMINAL_STATES:
             # drain hand-back: queued for whoever runs next
             with self._lock:
@@ -900,12 +936,18 @@ class FleetQueue:
                 # the terminal record is always last
                 job.state = state
                 job.finished_ts = self._clock()
-                if job.started_ts:
+                if job.started_ts and state == JOB_FINISHED:
                     dur = max(
                         job.finished_ts - job.started_ts, 0.0
                     )
-                    self._avg_job_s = (
-                        0.7 * self._avg_job_s + 0.3 * dur
+                    mics = max(
+                        job.progress.get("micrographs_total")
+                        or job.micrographs
+                        or 1,
+                        1,
+                    )
+                    self._avg_mic_s = (
+                        0.7 * self._avg_mic_s + 0.3 * dur / mics
                     )
                 self._note_terminal(job.id)
             # our commit won: exactly one terminal journal record
@@ -933,8 +975,7 @@ class FleetQueue:
         """A fenced replica stopping mid-job: record nothing terminal
         (the survivor owns the job now); just note the stop."""
         with self._lock:
-            if self._running == job.id:
-                self._running = None
+            self._running.discard(job.id)
         self.journal.record_event("fenced_stop", job=job.id)
 
     def _note_terminal(self, job_id: str) -> None:
@@ -958,7 +999,7 @@ class FleetQueue:
         """
         with self._lock:
             job = self._jobs.get(job_id)
-            running = self._running == job_id
+            running = job_id in self._running
         if job is not None and (
             running or job.state in TERMINAL_STATES
         ):
@@ -1009,7 +1050,7 @@ class FleetQueue:
 
         with self._lock:
             local = self._jobs.get(job_id)
-            locally_running = self._running == job_id
+            locally_running = job_id in self._running
             if local is not None and locally_running:
                 if local.state in TERMINAL_STATES:
                     return local

@@ -132,6 +132,7 @@ class Job:
     replica: str | None = None     # fleet: replica that ran/runs it
     deadline_ts: float | None = None
     bucket_hint: int | None = None
+    micrographs: int | None = None  # admission-time size estimate
     started_ts: float | None = None
     finished_ts: float | None = None
     error: dict | None = None
@@ -162,6 +163,8 @@ class Job:
             out["replica"] = self.replica
         if self.deadline_ts is not None:
             out["deadline_ts"] = self.deadline_ts
+        if self.micrographs is not None:
+            out["micrographs"] = self.micrographs
         if self.progress:
             out["progress"] = dict(self.progress)
         if self.result:
@@ -285,6 +288,7 @@ class ServeJournal:
                 idempotency_key=first.get("idempotency_key"),
                 deadline_ts=first.get("deadline_ts"),
                 bucket_hint=first.get("bucket_hint"),
+                micrographs=first.get("micrographs"),
                 resumed=state == JOB_RUNNING,
                 # an acknowledged running-job cancel survives the
                 # crash: the re-run stops at its first cancel poll
@@ -431,10 +435,16 @@ class JobQueue:
         self._pending: list[str] = []
         self._terminal: list[str] = []  # completion order (eviction)
         self._idemp: dict[str, str] = {}  # idempotency key -> job id
-        self._running: str | None = None
+        # the continuous batcher holds several jobs open at once, so
+        # "running" is a set, not a slot (the single-job scheduler is
+        # simply the |set| <= 1 case)
+        self._running: set[str] = set()
         self.draining = False
-        # decayed average job wall time, the Retry-After estimate
-        self._avg_job_s = 10.0
+        # decayed PER-MICROGRAPH service time, the Retry-After
+        # estimate's unit: whole-job averages over-estimate under
+        # batching, where many small jobs clear in one coalesced
+        # chunk (docs/serving.md "Overload")
+        self._avg_mic_s = 2.0
 
     # -- admission ----------------------------------------------------
 
@@ -445,6 +455,7 @@ class JobQueue:
         deadline_s: float | None = None,
         bucket_hint: int | None = None,
         idempotency_key: str | None = None,
+        micrographs: int | None = None,
     ) -> Job:
         """Admit one request or raise :class:`AdmissionError`."""
         return self.submit_idempotent(
@@ -452,6 +463,7 @@ class JobQueue:
             deadline_s=deadline_s,
             bucket_hint=bucket_hint,
             idempotency_key=idempotency_key,
+            micrographs=micrographs,
         )[0]
 
     def _lookup_idempotent(self, key: str | None) -> Job | None:
@@ -468,6 +480,7 @@ class JobQueue:
         deadline_s: float | None = None,
         bucket_hint: int | None = None,
         idempotency_key: str | None = None,
+        micrographs: int | None = None,
     ) -> tuple[Job, bool]:
         """:meth:`submit`, returning ``(job, deduped)``.
 
@@ -477,6 +490,13 @@ class JobQueue:
         request (lost 202, timeout, fleet failover to another
         replica) must never create a second job, never be 429'd, and
         must work even mid-drain.
+
+        ``micrographs`` may be a zero-arg callable (the daemon's
+        directory-listing estimator): it is resolved only after the
+        draining/breaker rejections, so a load-shedding daemon does
+        not pay disk I/O per refused request.  (A queue-full 429
+        still pays it — the backlog check needs the lock, and
+        listing must not run under it.)
         """
         existing = self._lookup_idempotent(idempotency_key)
         if existing is not None:
@@ -496,6 +516,8 @@ class JobQueue:
                 outcome="rejected", cause="circuit_open", code="503"
             )
             raise
+        if callable(micrographs):
+            micrographs = micrographs()
         with self._lock:
             # re-check under the creation lock: two concurrent
             # retries with one key must still yield one job
@@ -504,9 +526,7 @@ class JobQueue:
                 if job is not None:
                     _DEDUPED.inc()
                     return job, True
-            backlog = len(self._pending) + (
-                1 if self._running else 0
-            )
+            backlog = len(self._pending) + len(self._running)
             stormed = faults.check("request_storm", "submit")
             if backlog >= self.limit or stormed:
                 _REJECTED.inc(reason="queue_full")
@@ -534,6 +554,7 @@ class JobQueue:
                     else None
                 ),
                 bucket_hint=bucket_hint,
+                micrographs=micrographs,
             )
             # journal BEFORE the queue insert becomes visible: once
             # the caller sees 202 the job survives any crash
@@ -542,6 +563,8 @@ class JobQueue:
                 if idempotency_key
                 else {}
             )
+            if micrographs is not None:
+                extra["micrographs"] = micrographs
             self.journal.record(
                 job.id,
                 JOB_QUEUED,
@@ -564,11 +587,27 @@ class JobQueue:
         self._wake.set()
         return job, False
 
+    def _queued_micrographs(self) -> int:
+        """Backlog size in MICROGRAPHS (call with the lock held):
+        each queued job contributes its admission-time estimate,
+        defaulting to 1 when the daemon could not count its inputs."""
+        return sum(
+            (self._jobs[jid].micrographs or 1)
+            for jid in self._pending
+            if jid in self._jobs
+        )
+
     def _retry_after_s(self, backlog: int) -> float:
-        """429 backoff estimate: every queued job ahead costs ~one
-        decayed-average job (the fleet queue overrides this with the
-        fleet-wide depth spread over live replicas)."""
-        return self._avg_job_s * max(backlog, 1)
+        """429 backoff estimate: decayed per-MICROGRAPH service time
+        x queued micrographs (single-replica daemon: one consumer).
+        The old whole-job average over-estimated under continuous
+        batching — many small jobs clear together in one coalesced
+        chunk, so a queued job is NOT a unit of service time; its
+        micrographs are.  FleetQueue computes its own fleet-wide
+        variant inline (same pricing, depth summed over the merged
+        view and divided by LIVE replicas)."""
+        mics = max(self._queued_micrographs(), backlog, 1)
+        return self._avg_mic_s * mics
 
     def adopt(self, job: Job) -> None:
         """Re-queue a recovered job (daemon restart) — no admission
@@ -591,7 +630,14 @@ class JobQueue:
         while draining (queued jobs stay journaled for restart)."""
         if self.draining:
             return None
-        self._wake.wait(timeout)
+        # only block when the queue LOOKS empty: the wake event is
+        # edge-triggered (cleared per pop), so waiting on it with
+        # jobs already pending burned the full poll timeout between
+        # every two jobs of a burst — ~0.2 s of pure idle per job
+        with self._lock:
+            empty = not self._pending
+        if empty:
+            self._wake.wait(timeout)
         with self._lock:
             self._wake.clear()
             if self.draining or not self._pending:
@@ -611,7 +657,7 @@ class JobQueue:
             if pick:
                 head.skipped += 1
             jid = self._pending.pop(pick)
-            self._running = jid
+            self._running.add(jid)
             _DEPTH.set(len(self._pending))
             return self._jobs[jid]
 
@@ -619,17 +665,26 @@ class JobQueue:
         """Record a terminal (or re-queued) state for the job the
         worker just ran and update the Retry-After estimate."""
         with self._lock:
-            if self._running == job.id:
-                self._running = None
+            self._running.discard(job.id)
             job.state = state
             job.finished_ts = self._clock()
             if state in TERMINAL_STATES:
-                if job.started_ts:
+                if job.started_ts and state == JOB_FINISHED:
                     dur = max(
                         job.finished_ts - job.started_ts, 0.0
                     )
-                    self._avg_job_s = (
-                        0.7 * self._avg_job_s + 0.3 * dur
+                    # per-micrograph decayed service time; under
+                    # coalescing a job's wall includes peers' shares,
+                    # so this stays an upper-bound estimate (safe
+                    # direction for a backoff hint)
+                    mics = max(
+                        job.progress.get("micrographs_total")
+                        or job.micrographs
+                        or 1,
+                        1,
+                    )
+                    self._avg_mic_s = (
+                        0.7 * self._avg_mic_s + 0.3 * dur / mics
                     )
                 self._note_terminal(job.id)
         self.journal.record(
@@ -649,16 +704,32 @@ class JobQueue:
                 # the same cap as the job map
                 self._idemp.pop(evicted.idempotency_key, None)
 
+    def mark_failed(self, job: Job) -> None:
+        """Last-resort state flip when :meth:`finish` itself failed
+        (the journal may be down): the client-visible state must
+        still change, under the same lock every other writer
+        holds."""
+        with self._lock:
+            self._running.discard(job.id)
+            job.state = JOB_FAILED
+
     def mark_running(self, job: Job) -> None:
         # job.state is lock-guarded shared state (finish/cancel and
         # the HTTP doc() readers): RT301 — mutate under the lock,
         # journal outside it (the record is its own flush)
         with self._lock:
+            # a SAME-PROCESS re-run (the batcher's fallback demotes
+            # a job to the single-job path) keeps the original
+            # started_ts and must not observe queue wait twice —
+            # the failed batch's execution time is not queue wait
+            rerun = job.started_ts is not None
             job.state = JOB_RUNNING
-            job.started_ts = self._clock()
-        _QUEUE_WAIT.observe(
-            max(job.started_ts - job.accepted_ts, 0.0)
-        )
+            if not rerun:
+                job.started_ts = self._clock()
+        if not rerun:
+            _QUEUE_WAIT.observe(
+                max(job.started_ts - job.accepted_ts, 0.0)
+            )
         self.journal.record(
             job.id, JOB_RUNNING, resumed=job.resumed,
             trace=job.trace_id,

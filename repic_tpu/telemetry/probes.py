@@ -121,14 +121,40 @@ _compiles = 0
 _compile_seconds = 0.0
 _transfer_bytes = 0
 _transfer_fetches = 0
+_persistent_hits = 0
+_persistent_hit_seconds = 0.0
 
 
 def _on_event_duration(name: str, duration: float, **kw) -> None:
     global _compiles, _compile_seconds
+    global _persistent_hits, _persistent_hit_seconds
     if name == "/jax/core/compile/backend_compile_duration":
         with _lock:
             _compiles += 1
             _compile_seconds += float(duration)
+    elif name == "/jax/compilation_cache/cache_retrieval_time_sec":
+        # one event per executable DESERIALIZED from the persistent
+        # on-disk compilation cache (jax_compilation_cache_dir) —
+        # the restart-warm signal: a post-restart compile that was a
+        # persistent hit pays milliseconds of retrieval instead of a
+        # fresh XLA compile.  backend_compile_duration still fires
+        # for the same executable (with the tiny retrieval cost), so
+        # fresh compiles = backend compiles - persistent hits.
+        with _lock:
+            _persistent_hits += 1
+            _persistent_hit_seconds += float(duration)
+        try:
+            # lazy: compiles are rare, and a top-level import would
+            # tangle with the package __init__'s import of probes
+            from repic_tpu.telemetry import metrics as _m
+
+            _m.counter(
+                "repic_persistent_cache_hits_total",
+                "XLA executables deserialized from the persistent "
+                "on-disk compilation cache",
+            ).inc()
+        except Exception:  # pragma: no cover - degraded envs
+            pass
 
 
 def install() -> bool:
@@ -178,6 +204,29 @@ def compile_seconds() -> float:
     the delta the request tracer splits a chunk's compile segment out
     of (``docs/observability.md`` "Traces")."""
     return _compile_seconds
+
+
+def persistent_cache_hits() -> int:
+    """Executables deserialized from the persistent on-disk compile
+    cache so far (``runtime.compilecache``) — 0 when the cache is
+    disabled or the backend never hit it."""
+    return _persistent_hits
+
+
+def persistent_cache_hit_seconds() -> float:
+    """Cumulative wall seconds spent DESERIALIZING persistent-cache
+    entries — milliseconds where a fresh compile costs seconds; the
+    warmup journal event records the delta so the replay's cost is
+    attributable."""
+    return _persistent_hit_seconds
+
+
+def fresh_compiles() -> int:
+    """Backend compiles that were NOT persistent-cache retrievals —
+    the restart-warm acceptance counter: a daemon restarted onto a
+    populated compile cache must serve its first request with zero
+    of these after warmup."""
+    return max(_compiles - _persistent_hits, 0)
 
 
 def device_memory() -> dict:

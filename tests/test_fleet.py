@@ -360,16 +360,21 @@ def test_idempotent_submit_dedupes_across_replicas(tmp_path):
 
 @pytest.mark.faults
 def test_fleet_retry_after_spreads_over_live_replicas(tmp_path):
+    """Per-micrograph Retry-After: the 429 estimate is per-micrograph
+    service time x fleet-wide QUEUED MICROGRAPHS / live replicas —
+    the whole-job average over-estimated under continuous batching
+    (a queued job's micrographs, not the job, are the service unit)."""
     clk = Clock()
     a = _member(tmp_path, "a", clk)
     b = _member(tmp_path, "b", clk)
     qa = _queue(tmp_path, a, limit=1)
-    qa._avg_job_s = 40.0
-    qa.submit(dict(REQ))
+    qa._avg_mic_s = 10.0
+    qa.submit(dict(REQ), micrographs=4)
     with pytest.raises(AdmissionError) as exc:
         qa.submit(dict(REQ))
     assert exc.value.http_status == 429
-    # depth 1, avg 40 s, 2 live replicas -> ~20 s, not ~40 s
+    # 4 queued micrographs x 10 s/mic over 2 live replicas -> ~20 s,
+    # not the ~40 s a whole-job estimate would claim
     assert exc.value.retry_after_s == 20
     del b  # (b's heartbeat is on disk either way)
 

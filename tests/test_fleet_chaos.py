@@ -36,7 +36,8 @@ FIXTURE = os.path.join(
 
 
 def _spawn_replica(
-    fleet, wd, rid, hb="0.2", timeout="1.0", extra_env=None
+    fleet, wd, rid, hb="0.2", timeout="1.0", extra_env=None,
+    warmup=False,
 ):
     os.makedirs(wd, exist_ok=True)
     env = dict(
@@ -51,7 +52,8 @@ def _spawn_replica(
     return subprocess.Popen(
         [
             sys.executable, "-m", "repic_tpu.main", "serve", wd,
-            "--port", "0", "--no-warmup",
+            "--port", "0",
+            *(() if warmup else ("--no-warmup",)),
             "--fleet-dir", fleet,
             "--heartbeat-interval", hb,
             "--replica-timeout", timeout,
@@ -344,8 +346,11 @@ def test_replica_crash_fault_exits_25_and_survivor_finishes(
             open(os.path.join(fleet, f"_joblease.{jid}.json"))
         )
         assert lease["replica"] == "r1"
+        # the replacement replica runs its startup warmup: the dead
+        # replica's compiled programs live in the SHARED fleet
+        # compile cache, so r2 must replay them and start WARM
         procs["r2"] = _spawn_replica(
-            fleet, str(tmp_path / "wd_r2"), "r2"
+            fleet, str(tmp_path / "wd_r2"), "r2", warmup=True
         )
         p2 = _wait_port(str(tmp_path / "wd_r2"), procs["r2"])
         deadline = time.time() + 240
@@ -368,6 +373,20 @@ def test_replica_crash_fault_exits_25_and_survivor_finishes(
             e.get("event") == "job_reassigned"
             and e.get("from_replica") == "r1"
             for e in entries
+        )
+        # ISSUE 13: the replacement started WARM — its warmup
+        # replayed the crashed replica's recorded program(s) out of
+        # the shared on-disk compile cache (persistent hit, not a
+        # fresh compile of the serving program)
+        warmups = [
+            e for e in entries
+            if e.get("event") == "warmup"
+            and e.get("replica") == "r2"
+        ]
+        assert warmups, "r2 never journaled its warmup"
+        assert warmups[-1]["programs_warmed"] >= 1, warmups[-1]
+        assert warmups[-1]["persistent_cache_hits"] >= 1, (
+            warmups[-1]
         )
     finally:
         _kill_all(procs)
