@@ -161,6 +161,30 @@ def add_arguments(parser):
         "heartbeat interval)",
     )
     parser.add_argument(
+        "--tenants",
+        default=None,
+        metavar="FILE",
+        help="enable per-tenant auth + quotas from a JSON keyfile "
+        '({"tenants": [{"name", "keys", "rate", "burst", '
+        '"max_open_jobs", "max_queued_micrographs"}, ...]}).  '
+        "Requests then need 'Authorization: Bearer <key>' (401 "
+        "missing, 403 unknown); a tenant literally named "
+        "'anonymous' (no keys) admits keyless requests under its "
+        "limits.  Without this flag the daemon stays open exactly "
+        "as before (docs/serving.md \"Multi-tenancy\")",
+    )
+    parser.add_argument(
+        "--reassign-budget",
+        type=int,
+        default=2,
+        metavar="N",
+        help="per-job retry budget: a job may be (re)started at "
+        "most N+1 times across crashes/failovers before it is "
+        "QUARANTINED (terminal, never re-run) instead of taking "
+        "down the next worker — the poison-pill blast-radius bound "
+        "(default 2; docs/serving.md \"quarantine\")",
+    )
+    parser.add_argument(
         "--slo-target",
         action="append",
         default=None,
@@ -209,6 +233,8 @@ def main(args):
             max_open=args.max_open,
             compile_cache=args.compile_cache,
             warmup_buckets=warmup_buckets,
+            tenants=args.tenants,
+            reassign_budget=args.reassign_budget,
         )
     except ValueError as e:
         raise SystemExit(f"repic-tpu serve: {e}") from e

@@ -68,6 +68,16 @@ site              raised at the matching call site
                   (as if another survivor fenced the dead replica
                   first), deterministically exercising the
                   "someone else owns this takeover" branch
+``poison_job``    no exception — polled by
+                  ``serve.jobs.poison_point`` right after the
+                  worker binds a job to its input; a firing
+                  terminates the process with ``os._exit(POISON_
+                  CRASH_EXIT_CODE)``.  Key: ``<job_id>:<in_dir>``
+                  — plan on an input-directory substring with
+                  unlimited times (``poison_job:baddir:inf``) and
+                  the SAME job deterministically kills EVERY
+                  worker that attempts it: the poison pill the
+                  quarantine retry budget contains
 ================= ==================================================
 
 Injection is purely count-based (no randomness, no clocks): a
@@ -110,6 +120,7 @@ KNOWN_SITES = (
     "server_crash",
     "replica_crash",
     "lease_steal",
+    "poison_job",
 )
 
 

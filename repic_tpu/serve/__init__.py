@@ -38,10 +38,18 @@ defined by how it behaves when things go wrong:
   completion through a create-once token — any replica answers for
   any job, and a job survives the death of the replica running it.
 
-Deterministic failure testing uses six fault sites
+* **multi-tenancy + blast-radius containment** — per-tenant
+  API-key auth, token-bucket rate limits and quotas with distinct
+  429 causes, tenant-keyed fair share, and tenant-scoped circuit
+  breakers (:mod:`repic_tpu.serve.tenancy`); a per-job retry
+  budget quarantines poison-pill jobs (terminal ``quarantined``
+  through the exactly-once token) before they can serially take
+  down the fleet, and the request journal self-compacts.
+
+Deterministic failure testing uses seven fault sites
 (:mod:`repic_tpu.runtime.faults`): ``request_storm``,
 ``slow_client``, ``deadline_exceeded``, ``server_crash``,
-``replica_crash``, ``lease_steal``.
+``replica_crash``, ``lease_steal``, ``poison_job``.
 
 Operator docs: docs/serving.md.
 """

@@ -59,6 +59,7 @@ from repic_tpu.serve.jobs import (
     JOB_QUEUED,
     Job,
     crash_point,
+    poison_point,
 )
 from repic_tpu.telemetry import events as tlm_events
 from repic_tpu.telemetry import probes as tlm_probes
@@ -249,6 +250,7 @@ class ContinuousBatcher:
             kind="serve",
             job=job.id,
             accepted_ts=round(job.accepted_ts, 6),
+            **({"tenant": job.tenant} if job.tenant else {}),
         )
         job.trace_id = tctx.trace_id
         token = tlm_trace.activate(tctx)
@@ -296,6 +298,9 @@ class ContinuousBatcher:
             job.request.get("options") or {}
         )
         in_dir = job.request["in_dir"]
+        # poison pill: fires after mark_running journaled the
+        # attempt (the retry budget's unit) and before any artifact
+        poison_point(job.id, in_dir)
         box_size = job.request["box_size"]
         pickers = box_io.discover_picker_dirs(in_dir)
         if not pickers:
@@ -492,25 +497,15 @@ class ContinuousBatcher:
             target = min(avail, hi)
         else:
             target = min(avail, lo)
-        # fair share: deal slots one per job per round (rotating who
-        # picks first), so a burst of small jobs rides along with a
-        # large one instead of queueing behind it
+        # fair share: deal slots round-robin with a rotating first
+        # pick, keyed by TENANT above the per-job rotation — a burst
+        # of small jobs rides along with a large one, and one noisy
+        # tenant's many open jobs cannot crowd a quiet tenant's one
+        # job out of the chunk (each tenant gets one slot per round)
         self._rr += 1
         start = self._rr % len(jobs)
         order = jobs[start:] + jobs[:start]
-        alloc = {id(oj): 0 for oj in order}
-        dealt = 0
-        while dealt < target:
-            progressed = False
-            for oj in order:
-                if dealt >= target:
-                    break
-                if alloc[id(oj)] < len(oj.pending):
-                    alloc[id(oj)] += 1
-                    dealt += 1
-                    progressed = True
-            if not progressed:
-                break
+        alloc = self._deal(order, target)
         parts = []
         for oj in order:
             n = alloc[id(oj)]
@@ -518,6 +513,44 @@ class ContinuousBatcher:
                 parts.append((oj, oj.pending[:n]))
                 del oj.pending[:n]
         return parts or None
+
+    @staticmethod
+    def _deal(order, target: int) -> dict:
+        """Deal ``target`` chunk slots across the group's open jobs:
+        one slot per TENANT per round (tenants rotate in ``order``'s
+        rotation), and within a tenant one slot per job per ITS
+        round.  With a single tenant (or no tenancy — tenant None)
+        this degenerates to the original per-job round-robin; with
+        several it is micrograph-level fair share per tenant.
+        Returns ``{id(open_job): slots}``."""
+        by_tenant: dict = {}
+        tenant_order: list = []
+        for oj in order:
+            t = getattr(oj.job, "tenant", None)
+            if t not in by_tenant:
+                by_tenant[t] = []
+                tenant_order.append(t)
+            by_tenant[t].append(oj)
+        alloc = {id(oj): 0 for oj in order}
+        nxt = dict.fromkeys(tenant_order, 0)
+        dealt = 0
+        while dealt < target:
+            progressed = False
+            for t in tenant_order:
+                if dealt >= target:
+                    break
+                tjobs = by_tenant[t]
+                for k in range(len(tjobs)):
+                    oj = tjobs[(nxt[t] + k) % len(tjobs)]
+                    if alloc[id(oj)] < len(oj.pending):
+                        alloc[id(oj)] += 1
+                        dealt += 1
+                        progressed = True
+                        nxt[t] = (nxt[t] + k + 1) % len(tjobs)
+                        break
+            if not progressed:
+                break
+        return alloc
 
     def _ladder_around(self, m: int) -> tuple:
         """The chunk-shape ladder values bracketing ``m``: powers of
@@ -803,7 +836,7 @@ class ContinuousBatcher:
             particles=job.result["particles"],
             quarantined=job.result["quarantined"],
         )
-        self.queue.breaker.record_success()
+        self.queue.breaker.record_success(job.tenant)
         self._drop(oj)
         telemetry.finish_run(oj.rt)
         oj.tctx.close()
@@ -833,7 +866,7 @@ class ContinuousBatcher:
             self.daemon._finish_job(job, JOB_FAILED, error=job.error)
         except Exception:  # noqa: BLE001 - the journal may be down
             self.queue.mark_failed(job)
-        self.queue.breaker.record_failure()
+        self.queue.breaker.record_failure(job.tenant)
         _log.error(f"job {job.id} failed: {exc}")
         return None
 

@@ -37,18 +37,24 @@ import time
 from repic_tpu import telemetry
 from repic_tpu.runtime import faults
 from repic_tpu.runtime.atomic import atomic_write
+from repic_tpu.serve import jobs as jobs_mod
+from repic_tpu.serve import tenancy
 from repic_tpu.serve.jobs import (
+    DEFAULT_REASSIGN_BUDGET,
     JOB_CANCELLED,
     JOB_DEADLINE_EXCEEDED,
     JOB_FAILED,
     JOB_FINISHED,
+    JOB_QUARANTINED,
     JOB_QUEUED,
+    _QUARANTINED,
     AdmissionError,
     CircuitBreaker,
     Job,
     JobQueue,
     ServeJournal,
     crash_point,
+    poison_point,
 )
 from repic_tpu.telemetry import events as tlm_events
 from repic_tpu.telemetry import server as tlm_server
@@ -213,24 +219,68 @@ class ServeServer(tlm_server.StatusServer):
             return False
         parts = [p for p in path.split("/") if p][2:]  # after v1/jobs
         try:
+            # identity gate for the whole job API (observability
+            # endpoints stay open — they bind 127.0.0.1 and carry
+            # no tenant data): with no --tenants file this resolves
+            # to None and the API behaves exactly as before
+            try:
+                tenant = self._resolve_tenant(handler)
+            except tenancy.AuthError as e:
+                tenancy.note_auth_failure(e.http_status)
+                hdrs = (
+                    {"WWW-Authenticate": "Bearer"}
+                    if e.http_status == 401
+                    else None
+                )
+                self._json(
+                    handler, e.http_status,
+                    {"error": e.reason}, hdrs,
+                )
+                return True
             if method == "POST" and not parts:
-                self._submit(handler, body)
+                self._submit(handler, body, tenant)
             elif method == "GET" and not parts:
                 _REQUESTS.inc(route="jobs_list")
                 docs = sorted(
-                    (j.doc() for j in self.daemon.queue.jobs()),
+                    (
+                        j.doc()
+                        for j in self.daemon.queue.jobs()
+                        if self._owned(j, tenant)
+                    ),
                     key=lambda d: d["accepted_ts"],
                 )
                 self._json(handler, 200, {"jobs": docs})
             elif len(parts) == 1:
-                self._one_job(handler, method, parts[0])
+                self._one_job(handler, method, parts[0], tenant)
             elif len(parts) >= 2 and parts[1] == "artifacts":
-                self._artifacts(handler, method, parts)
+                self._artifacts(handler, method, parts, tenant)
             else:
                 self._json(handler, 404, {"error": "not found"})
         except BrokenPipeError:
             pass  # client vanished mid-response; nothing to clean
         return True
+
+    def _resolve_tenant(self, handler) -> str | None:
+        """The request's authenticated tenant, or None when tenancy
+        is not configured (today's open single-tenant behavior)."""
+        registry = self.daemon.tenancy
+        if registry is None:
+            return None
+        return registry.resolve(
+            handler.headers.get("Authorization")
+        )
+
+    @staticmethod
+    def _owned(job, tenant: str | None) -> bool:
+        """Tenant isolation on the read/cancel surface: with tenancy
+        configured, a job is visible only to the tenant that
+        submitted it (pre-tenancy jobs — tenant None — stay visible
+        to everyone, so enabling auth does not orphan history)."""
+        return (
+            tenant is None
+            or job.tenant is None
+            or job.tenant == tenant
+        )
 
     def _json(self, handler, code: int, doc: dict,
               headers: dict | None = None):
@@ -240,7 +290,8 @@ class ServeServer(tlm_server.StatusServer):
             headers,
         )
 
-    def _submit(self, handler, body: bytes):
+    def _submit(self, handler, body: bytes,
+                tenant: str | None = None):
         _REQUESTS.inc(route="jobs_submit")
         try:
             (request, options, deadline_s, hint,
@@ -256,6 +307,7 @@ class ServeServer(tlm_server.StatusServer):
                 deadline_s=deadline_s,
                 bucket_hint=hint,
                 idempotency_key=idempotency_key,
+                tenant=tenant,
                 # lazy: the queue resolves this only past the
                 # draining/breaker rejections — load shedding must
                 # not pay directory listings per refused request
@@ -280,11 +332,19 @@ class ServeServer(tlm_server.StatusServer):
             return
         self._json(handler, 202, job.doc())
 
-    def _one_job(self, handler, method, job_id):
+    def _one_job(self, handler, method, job_id,
+                 tenant: str | None = None):
         job = self.daemon.queue.get(job_id)
         if job is None:
             _REQUESTS.inc(route="jobs_get")
             self._json(handler, 404, {"error": f"no job {job_id}"})
+        elif not self._owned(job, tenant):
+            _REQUESTS.inc(route="jobs_get")
+            tenancy.note_auth_failure(403, cause="ownership")
+            self._json(
+                handler, 403,
+                {"error": "job belongs to another tenant"},
+            )
         elif method == "DELETE":
             _REQUESTS.inc(route="jobs_cancel")
             got = self.daemon.queue.cancel(job_id)
@@ -296,12 +356,20 @@ class ServeServer(tlm_server.StatusServer):
         else:
             self._json(handler, 405, {"error": "method not allowed"})
 
-    def _artifacts(self, handler, method, parts):
+    def _artifacts(self, handler, method, parts,
+                   tenant: str | None = None):
         _REQUESTS.inc(route="artifacts")
         job = self.daemon.queue.get(parts[0])
         if job is None or method != "GET":
             code = 404 if job is None else 405
             self._json(handler, code, {"error": "not found"})
+            return
+        if not self._owned(job, tenant):
+            tenancy.note_auth_failure(403, cause="ownership")
+            self._json(
+                handler, 403,
+                {"error": "job belongs to another tenant"},
+            )
             return
         out_dir = self.daemon.job_dir(job.id)
         names = sorted(
@@ -366,6 +434,8 @@ class ConsensusDaemon:
         max_open: int = 4,
         compile_cache: str | None = None,
         warmup_buckets: list | None = None,
+        tenants=None,
+        reassign_budget: int = DEFAULT_REASSIGN_BUDGET,
         clock=time.time,
     ):
         if scheduler not in ("batch", "single"):
@@ -390,6 +460,24 @@ class ConsensusDaemon:
         self.warmup_bucket_list = list(warmup_buckets or ())
         self.batcher = None
         self._clock = clock
+        if int(reassign_budget) < 0:
+            raise ValueError(
+                f"reassign budget must be >= 0, "
+                f"got {reassign_budget}"
+            )
+        self.reassign_budget = int(reassign_budget)
+        # tenancy: a keyfile path, a ready TenantRegistry (tests),
+        # or None — None keeps the open single-tenant behavior
+        # (docs/serving.md "Multi-tenancy"); a bad keyfile is a
+        # startup ValueError, never a silently-unauthenticated port
+        if tenants is None or isinstance(
+            tenants, tenancy.TenantRegistry
+        ):
+            self.tenancy = tenants
+        else:
+            self.tenancy = tenancy.TenantRegistry.load(
+                tenants, clock=clock
+            )
         # rolling SLO view for /status (always on — without
         # --slo-target objectives it still reports p50/p95/p99)
         self.slo = tlm_server.SLOTracker(objectives=slo_targets)
@@ -411,6 +499,7 @@ class ConsensusDaemon:
                 replica_id,
                 heartbeat_interval_s=heartbeat_interval_s,
                 replica_timeout_s=replica_timeout_s,
+                reassign_budget=self.reassign_budget,
                 clock=clock,
             )
             self.journal = ServeJournal(
@@ -421,12 +510,17 @@ class ConsensusDaemon:
                 self.journal,
                 self.fleet,
                 breaker,
+                tenants=self.tenancy,
                 clock=clock,
             )
         else:
             self.journal = ServeJournal(self.work_dir)
             self.queue = JobQueue(
-                queue_limit, self.journal, breaker, clock=clock
+                queue_limit,
+                self.journal,
+                breaker,
+                tenants=self.tenancy,
+                clock=clock,
             )
         self.server = ServeServer(self, port, host)
         # persistent compile cache (docs/serving.md "Compile cache
@@ -464,6 +558,7 @@ class ConsensusDaemon:
         return os.path.join(root, "jobs", job_id)
 
     def start(self) -> "ConsensusDaemon":
+        self._compact_journal()
         if self.fleet is not None:
             # membership first: the heartbeat must be fresh (and any
             # stale self-fence cleared) before peers see our journal
@@ -480,8 +575,30 @@ class ConsensusDaemon:
             recovered=[j.id for j in recovered],
         )
         if self.fleet is None:
+            runnable = []
             for job in recovered:
-                self.queue.adopt(job)
+                # the single-replica half of the poison-pill budget:
+                # a journaled in-flight job that already crashed
+                # budget + 1 daemon generations is quarantined here
+                # instead of re-crashing this one (docs/serving.md)
+                if job.attempts > self.reassign_budget:
+                    self.queue.adopt(job, runnable=False)
+                    job.reason = jobs_mod.quarantine_reason(
+                        job.attempts, self.reassign_budget
+                    )
+                    self._finish_job(
+                        job, JOB_QUARANTINED,
+                        reason=job.reason,
+                        attempts=job.attempts,
+                    )
+                    _QUARANTINED.inc(path="recover")
+                    _log.error(
+                        f"quarantined job {job.id}: {job.reason}"
+                    )
+                else:
+                    self.queue.adopt(job)
+                    runnable.append(job)
+            recovered = runnable
         if recovered:
             _log.info(
                 f"recovered {len(recovered)} journaled job(s) "
@@ -539,6 +656,40 @@ class ConsensusDaemon:
                   "the next start")
         return left
 
+    def _compact_journal(self) -> None:
+        """Bound request-journal growth (ServeJournal.compact) at
+        the two safe moments — startup before recovery, clean drain
+        after close — and never let a compaction problem take the
+        daemon down: the journal's append path works regardless."""
+        try:
+            terminal_ids = None
+            if self.fleet is not None:
+                # fleet mode: a job accepted HERE usually finishes
+                # on a peer, so this replica's own file never holds
+                # its terminal record — classify against the merged
+                # view (plus the exactly-once tokens) or the
+                # acceptor's journal would grow forever
+                view = self.queue.fleet_view()
+                terminal_ids = {
+                    jid
+                    for jid, info in view.items()
+                    if info["state"] in jobs_mod.TERMINAL_STATES
+                    or self.fleet.read_done(jid) is not None
+                }
+            stats = self.journal.compact(
+                max_terminal=JobQueue.MAX_TERMINAL,
+                terminal_ids=terminal_ids,
+            )
+        except Exception as e:  # noqa: BLE001 - never fatal
+            _log.error(f"journal compaction failed: {e}")
+            return
+        if stats:
+            _log.info(
+                f"compacted request journal: {stats['folded']} "
+                f"terminal job(s) folded, "
+                f"{stats['dropped_events']} old event(s) dropped"
+            )
+
     def finish_drain(self) -> None:
         """Phase 2: wait out the worker, then stop serving."""
         if self._worker is not None:
@@ -553,6 +704,9 @@ class ConsensusDaemon:
             tlm_server.set_slo_tracker(None)
         self.server.stop()
         self.journal.close()
+        # clean drain is the other safe single-writer moment: the
+        # next generation starts against an already-bounded journal
+        self._compact_journal()
 
     def drain(self) -> None:
         self.begin_drain()
@@ -579,7 +733,36 @@ class ConsensusDaemon:
         )
         if self.fleet is not None:
             fields["fleet"] = self.queue.fleet_status()
+        if self.tenancy is not None:
+            fields["tenants"] = self._tenant_status()
         tlm_server.set_status(**fields)
+
+    def _tenant_status(self) -> dict:
+        """The /status ``tenants`` section: per-tenant live load
+        (open jobs, queued micrographs), configured limits + rate
+        state, rejection tallies, and the tenant's breaker slot —
+        pushing the same numbers onto the repic_tenant_* gauges."""
+        tallies = self.queue.tenant_tallies()
+        breaker = self.queue.breaker.describe().get("tenants", {})
+        out = {}
+        for name in self.tenancy.names():
+            t = tallies.get(name, {})
+            entry = {
+                "open_jobs": t.get("open_jobs", 0),
+                "queued_micrographs": t.get(
+                    "queued_micrographs", 0
+                ),
+            }
+            entry.update(self.tenancy.describe(name))
+            if name in breaker:
+                entry["breaker"] = breaker[name]
+            tenancy.set_tenant_gauges(
+                name,
+                entry["open_jobs"],
+                entry["queued_micrographs"],
+            )
+            out[name] = entry
+        return out
 
     # -- worker -------------------------------------------------------
 
@@ -651,7 +834,7 @@ class ConsensusDaemon:
                     )
                 except Exception:  # the journal itself may be down
                     self.queue.mark_failed(job)
-                self.queue.breaker.record_failure()
+                self.queue.breaker.record_failure(job.tenant)
                 _log.error(f"worker error on job {job.id}: {e}")
             self.publish_status()
 
@@ -704,16 +887,27 @@ class ConsensusDaemon:
 
         self.queue.finish(job, state, **fields)
         if state in TERMINAL_STATES:
+            latency = max(
+                (job.finished_ts or self._clock())
+                - job.accepted_ts,
+                0.0,
+            )
             tlm_server.observe_slo(
                 "job",
-                max(
-                    (job.finished_ts or self._clock())
-                    - job.accepted_ts,
-                    0.0,
-                ),
+                latency,
                 ok=state == JOB_FINISHED,
                 bucket=job.progress.get("capacity"),
             )
+            if job.tenant is not None:
+                # the per-tenant SLO bucket (ISSUE 14): tenant B's
+                # compliance is readable off /status independent of
+                # tenant A's throttling or failures — objectives
+                # inherit the `job` target (telemetry.server)
+                tlm_server.observe_slo(
+                    f"tenant:{job.tenant}",
+                    latency,
+                    ok=state == JOB_FINISHED,
+                )
 
     def _run_job(self, job: Job):
         """Execute one job through the engine; returns the warmed
@@ -751,6 +945,9 @@ class ConsensusDaemon:
             kind="serve",
             job=job.id,
             accepted_ts=round(job.accepted_ts, 6),
+            # tenant attribution rides the trace root: a waterfall
+            # answers "whose request was this" without the journal
+            **({"tenant": job.tenant} if job.tenant else {}),
         )
         # a job recovered from a pre-tracing journal gains an id here
         job.trace_id = tctx.trace_id
@@ -802,6 +999,10 @@ class ConsensusDaemon:
                 job.request.get("options") or {}
             )
             in_dir = job.request["in_dir"]
+            # the poison pill fires HERE, after mark_running's
+            # journal record (so every attempt is counted toward
+            # the retry budget) and before any artifact lands
+            poison_point(job.id, in_dir)
             box_size = job.request["box_size"]
             pickers = box_io.discover_picker_dirs(in_dir)
             if not pickers:
@@ -1043,7 +1244,7 @@ class ConsensusDaemon:
                 particles=job.result["particles"],
                 quarantined=job.result["quarantined"],
             )
-            self.queue.breaker.record_success()
+            self.queue.breaker.record_success(job.tenant)
             return bucket
         except engine.ConsensusCancelled:
             # cooperative stop at a chunk boundary: every completed
@@ -1074,7 +1275,7 @@ class ConsensusDaemon:
             # daemon and every other job keep going
             job.error = self.queue.error_doc(e)
             self._finish_job(job, JOB_FAILED, error=job.error)
-            self.queue.breaker.record_failure()
+            self.queue.breaker.record_failure(job.tenant)
             _log.error(f"job {job.id} failed: {e}")
             return bucket
         finally:

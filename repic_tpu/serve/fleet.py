@@ -73,10 +73,13 @@ from repic_tpu.runtime.journal import (
     sanitize_host_id,
 )
 from repic_tpu.runtime.ladder import HOST_LIVE
+from repic_tpu.serve import tenancy
 from repic_tpu.serve.jobs import (
+    DEFAULT_REASSIGN_BUDGET,
     JOB_CANCELLED,
     JOB_FAILED,
     JOB_FINISHED,
+    JOB_QUARANTINED,
     JOB_QUEUED,
     JOB_RUNNING,
     SERVE_JOURNAL_NAME,
@@ -115,6 +118,10 @@ _LIVE = telemetry.gauge(
 _FLEET_DEPTH = telemetry.gauge(
     "repic_fleet_queue_depth",
     "fleet-wide queued (unleased) jobs in the shared queue",
+)
+_FLEET_QUARANTINED = telemetry.counter(
+    "repic_fleet_quarantined_total",
+    "jobs this replica quarantined over their retry budget",
 )
 
 
@@ -180,8 +187,19 @@ class FleetMember:
         *,
         heartbeat_interval_s: float = 2.0,
         replica_timeout_s: float = 10.0,
+        reassign_budget: int = DEFAULT_REASSIGN_BUDGET,
         clock=time.time,
     ):
+        if int(reassign_budget) < 0:
+            raise ValueError(
+                f"reassign budget must be >= 0, "
+                f"got {reassign_budget}"
+            )
+        #: per-job retry budget: a job whose journaled run attempts
+        #: already reach budget + 1 is QUARANTINED at the next
+        #: lease-steal (or restart-recovery) instead of re-run —
+        #: the poison-pill blast-radius bound (docs/serving.md)
+        self.reassign_budget = int(reassign_budget)
         self.fleet_dir = os.path.abspath(fleet_dir)
         self.replica = sanitize_host_id(
             replica_id or resolve_replica_id()
@@ -347,6 +365,16 @@ class FleetMember:
         fenced — exactly one survivor wins — and the winner rewrites
         the lease onto itself.  Returns the stolen job ids; the
         caller's next scheduling pass picks them up as its own.
+
+        **Retry budget (ISSUE 14).**  The steal is where a poison
+        pill would propagate: a job whose input deterministically
+        kills its worker is fenced, stolen, and re-run by each
+        survivor in turn, serially taking down the whole fleet.  So
+        the budget is checked HERE: a job whose journaled run
+        attempts already reach ``reassign_budget + 1`` is not stolen
+        — the fence winner commits it terminal ``quarantined``
+        through the exactly-once completion token instead, with full
+        provenance (attempts, last holder) in the journal.
         """
         orphaned: dict[str, list[str]] = {}
         for jid, info in jobs_view.items():
@@ -372,9 +400,73 @@ class FleetMember:
             if not self._fence_replica(holder, st, journal):
                 continue  # another survivor owns this takeover
             for jid in sorted(jids):
+                info = jobs_view.get(jid) or {}
+                runs = int(info.get("runs", 0))
+                if runs > self.reassign_budget:
+                    self.quarantine(
+                        jid,
+                        info,
+                        journal,
+                        last_replica=holder,
+                    )
+                    continue
                 self.steal_lease(jid, holder, journal)
                 stolen.append(jid)
         return stolen
+
+    def quarantine(self, jid: str, info: dict, journal=None,
+                   last_replica: str | None = None,
+                   path: str = "steal") -> bool:
+        """Commit a job terminal ``quarantined`` exactly once.
+
+        Goes through the same completion-token path as a normal
+        finish (:meth:`commit_terminal`): of N replicas deciding the
+        same budget overrun concurrently, exactly one link wins and
+        exactly one terminal journal record lands — a quarantined
+        job can never be re-run, and its provenance (attempt count,
+        the replica that died holding it) reads straight off the
+        journal.  Returns True when THIS replica's commit won."""
+        from repic_tpu.serve.jobs import quarantine_reason
+
+        runs = int(info.get("runs", 0))
+        first = info.get("first") or {}
+        reason = quarantine_reason(runs, self.reassign_budget)
+        winner = self.commit_terminal(
+            jid,
+            JOB_QUARANTINED,
+            reason=reason,
+            attempts=runs,
+            last_replica=last_replica,
+        )
+        if winner is not None:
+            return False
+        if journal is not None:
+            journal.record(
+                jid,
+                JOB_QUARANTINED,
+                reason=reason,
+                attempts=runs,
+                last_replica=last_replica,
+                trace=first.get("trace"),
+            )
+        _FLEET_QUARANTINED.inc()
+        from repic_tpu.serve.jobs import _JOBS, _QUARANTINED
+
+        _QUARANTINED.inc(path=path)
+        _JOBS.inc(state=JOB_QUARANTINED)
+        tenant = first.get("tenant")
+        if tenant:
+            tenancy.note_job(tenant, JOB_QUARANTINED)
+        from repic_tpu.telemetry import server as tlm_server
+
+        now = self._clock()
+        latency = max(now - float(first.get("ts", now)), 0.0)
+        tlm_server.observe_slo("job", latency, ok=False)
+        if tenant:
+            tlm_server.observe_slo(
+                f"tenant:{tenant}", latency, ok=False
+            )
+        return True
 
     # -- exactly-once completion --------------------------------------
 
@@ -463,6 +555,7 @@ class FleetQueue:
         member: FleetMember,
         breaker: CircuitBreaker | None = None,
         *,
+        tenants: "tenancy.TenantRegistry | None" = None,
         clock=time.time,
     ):
         if limit < 1:
@@ -471,11 +564,13 @@ class FleetQueue:
         self.journal = journal
         self.member = member
         self.breaker = breaker or CircuitBreaker()
+        self.tenants = tenants
         self._clock = clock
         self._lock = threading.Lock()
         self._jobs: dict[str, Job] = {}   # jobs this replica touched
         self._terminal: list[str] = []
-        self._idemp: dict[str, str] = {}
+        # (tenant, key) -> job id: per-tenant scoping, like JobQueue
+        self._idemp: dict[tuple, str] = {}
         # several leases may be held open at once (the continuous
         # batcher coalesces jobs), so "running" is a set
         self._running: set[str] = set()
@@ -525,6 +620,7 @@ class FleetQueue:
                     "latest": e,
                     "state": e.get("state"),
                     "cancel_requested": False,
+                    "runs": 0,
                 }
             elif (
                 "request" in e and "request" not in slot["first"]
@@ -536,6 +632,18 @@ class FleetQueue:
                 slot["first"] = e
             slot["latest"] = e
             slot["state"] = e.get("state")
+            if (
+                e.get("state") == JOB_RUNNING
+                and not e.get("cancel_requested")
+                and not e.get("rerun")
+            ):
+                # fleet-wide run-attempt count: every replica's
+                # mark_running lands one — the retry budget's input
+                # at steal/recovery time.  Cancel-flag records and
+                # same-process rerun records (the batcher's
+                # coalesce-fallback demotion) are bookkeeping, not
+                # crashed generations, and must not bill the budget
+                slot["runs"] += 1
             if e.get("cancel_requested"):
                 slot["cancel_requested"] = True
         for jid in cancels:
@@ -564,6 +672,7 @@ class FleetQueue:
             request=first.get("request", {}),
             accepted_ts=float(first.get("ts", self._clock())),
             state=state,
+            tenant=first.get("tenant"),
             trace_id=first.get("trace"),
             idempotency_key=first.get("idempotency_key"),
             replica=latest.get("replica"),
@@ -571,6 +680,7 @@ class FleetQueue:
             bucket_hint=first.get("bucket_hint"),
             micrographs=first.get("micrographs"),
             resumed=bool(latest.get("resumed", False)),
+            attempts=int(info.get("runs", 0)),
             cancel_requested=info["cancel_requested"],
         )
         if state in TERMINAL_STATES:
@@ -597,13 +707,15 @@ class FleetQueue:
     # -- admission ----------------------------------------------------
 
     def submit(self, request, *, deadline_s=None, bucket_hint=None,
-               idempotency_key=None, micrographs=None) -> Job:
+               idempotency_key=None, micrographs=None,
+               tenant=None) -> Job:
         return self.submit_idempotent(
             request,
             deadline_s=deadline_s,
             bucket_hint=bucket_hint,
             idempotency_key=idempotency_key,
             micrographs=micrographs,
+            tenant=tenant,
         )[0]
 
     def submit_idempotent(
@@ -614,12 +726,18 @@ class FleetQueue:
         bucket_hint: int | None = None,
         idempotency_key: str | None = None,
         micrographs: int | None = None,
+        tenant: str | None = None,
     ) -> tuple[Job, bool]:
         """Admit one request (or dedupe a retry) fleet-wide.
 
         The idempotency check spans EVERY replica's journal: a client
         whose 202 was lost to a replica crash retries against any
         survivor and gets the original job id back, not a duplicate.
+        Keys are scoped per tenant — one tenant's retry can never
+        alias into another tenant's job.  Tenant quotas are
+        fleet-wide too: open jobs and queued micrographs are counted
+        over the merged journal view, so a tenant cannot multiply
+        its budget by spraying submissions across replicas.
         """
         from repic_tpu.serve.jobs import (
             _ADMISSION,
@@ -630,13 +748,14 @@ class FleetQueue:
 
         if idempotency_key:
             with self._lock:
-                jid = self._idemp.get(idempotency_key)
+                jid = self._idemp.get((tenant, idempotency_key))
                 local = self._jobs.get(jid) if jid else None
             if local is None:
                 for jid, info in self.fleet_view().items():
                     if (
                         info["first"].get("idempotency_key")
                         == idempotency_key
+                        and info["first"].get("tenant") == tenant
                     ):
                         local = self._jobs.get(jid) or (
                             self._materialize(jid, info)
@@ -652,11 +771,11 @@ class FleetQueue:
             )
             raise AdmissionError(503, "draining", 30.0)
         try:
-            self.breaker.check_admission()
-        except AdmissionError:
-            _REJECTED.inc(reason="circuit_open")
+            self.breaker.check_admission(tenant)
+        except AdmissionError as e:
+            _REJECTED.inc(reason=e.reason)
             _ADMISSION.inc(
-                outcome="rejected", cause="circuit_open", code="503"
+                outcome="rejected", cause=e.reason, code="503"
             )
             raise
         if callable(micrographs):
@@ -694,16 +813,55 @@ class FleetQueue:
             # one job (the same guard JobQueue.submit_idempotent
             # carries; peers racing the same key across replicas
             # are deduped best-effort by the pre-scan above)
-            if idempotency_key and idempotency_key in self._idemp:
-                job = self._jobs.get(self._idemp[idempotency_key])
+            if idempotency_key:
+                jid = self._idemp.get((tenant, idempotency_key))
+                job = self._jobs.get(jid) if jid else None
                 if job is not None:
                     _DEDUPED.inc()
                     return job, True
+            # tenant limits INSIDE the creation lock, mirroring
+            # JobQueue: two racing same-replica submissions must
+            # serialize through the quota comparison + the insert
+            # that changes its inputs (the view is refreshed here —
+            # this replica's own just-journaled accepts are in it;
+            # cross-replica admission stays best-effort, like the
+            # fleet-wide depth check above).  In-lock cost is
+            # bounded: the refresh is the incremental size-keyed
+            # reader, and the tally's read_done/lease probes fire
+            # only for NON-terminal jobs (the in-view state check
+            # short-circuits the MAX_TERMINAL history), i.e. O(open
+            # jobs), not O(journal)
+            if self.tenants is not None and tenant is not None:
+                open_jobs, queued_mics = (
+                    self._tenant_view_tallies(
+                        self.fleet_view(), tenant
+                    )
+                )
+                refused = self.tenants.check_admission(
+                    tenant,
+                    micrographs=micrographs or 1,
+                    open_jobs=open_jobs,
+                    queued_micrographs=queued_mics,
+                    per_mic_s=self._avg_mic_s / live,
+                )
+                if refused is not None:
+                    cause, retry_after = refused
+                    code = (
+                        413 if cause == "tenant_job_too_large"
+                        else 429
+                    )
+                    _REJECTED.inc(reason=cause)
+                    _ADMISSION.inc(
+                        outcome="rejected", cause=cause,
+                        code=str(code),
+                    )
+                    raise AdmissionError(code, cause, retry_after)
             now = self._clock()
             job = Job(
                 id=new_job_id(),
                 request=request,
                 accepted_ts=now,
+                tenant=tenant,
                 trace_id=tlm_trace.new_trace_id(),
                 idempotency_key=idempotency_key,
                 deadline_ts=(
@@ -721,6 +879,8 @@ class FleetQueue:
             )
             if micrographs is not None:
                 extra["micrographs"] = micrographs
+            if tenant is not None:
+                extra["tenant"] = tenant
             # journal-before-202 (under the lock, like JobQueue):
             # the accepting replica's flushed record IS the durable
             # enqueue every peer can see and claim
@@ -735,13 +895,51 @@ class FleetQueue:
             )
             self._jobs[job.id] = job
             if idempotency_key:
-                self._idemp[idempotency_key] = job.id
+                self._idemp[(tenant, idempotency_key)] = job.id
         _ADMITTED.inc()
         _ADMISSION.inc(
             outcome="accepted", cause="accepted", code="202"
         )
+        if tenant is not None:
+            tenancy.note_admitted(tenant)
         serve_crash_point(f"accept:{job.id}")
         return job, False
+
+    def _tenant_view_tallies(
+        self, view: dict, tenant: str
+    ) -> tuple[int, int]:
+        """(open jobs, queued micrographs) for one tenant over the
+        MERGED fleet view — quota inputs span every replica."""
+        slot = self.tenant_tallies(view).get(tenant) or {}
+        return (
+            slot.get("open_jobs", 0),
+            slot.get("queued_micrographs", 0),
+        )
+
+    def tenant_tallies(self, view: dict | None = None) -> dict:
+        """Per-tenant open-job / queued-micrograph tallies over the
+        merged view (fleet-wide, not this replica's) — the ONE
+        accumulator behind both the admission quota inputs and the
+        /status ``tenants`` section, so "what counts as queued
+        work" cannot diverge between the two."""
+        out: dict[str, dict] = {}
+        view = self.fleet_view() if view is None else view
+        for jid, info in view.items():
+            tenant = info["first"].get("tenant")
+            if tenant is None or not self._is_open(jid, info):
+                continue
+            slot = out.setdefault(
+                tenant, {"open_jobs": 0, "queued_micrographs": 0}
+            )
+            slot["open_jobs"] += 1
+            if (
+                info["state"] == JOB_QUEUED
+                and self.member.lease_info(jid) is None
+            ):
+                slot["queued_micrographs"] += (
+                    info["first"].get("micrographs") or 1
+                )
+        return out
 
     def _fleet_depth(self, view: dict | None = None) -> int:
         """Fleet-wide queued (unleased) jobs — the shared backlog."""
@@ -762,7 +960,12 @@ class FleetQueue:
         """Jobs this replica still holds the lease for (a restart
         under the same replica id): adopt and re-run them with resume
         semantics.  Queued-but-unleased jobs need no adoption — the
-        normal scheduling pass claims them."""
+        normal scheduling pass claims them.
+
+        The retry budget applies here exactly as at lease-steal: a
+        restarting replica whose own held job keeps crashing it
+        (the single-replica poison-pill shape) quarantines the job
+        instead of re-running into the same crash forever."""
         out = []
         for jid, info in self.fleet_view().items():
             if not self._is_open(jid, info):
@@ -772,15 +975,51 @@ class FleetQueue:
                 self.member.replica
             ):
                 continue
+            if int(info.get("runs", 0)) > (
+                self.member.reassign_budget
+            ):
+                self._quarantine_held(jid, info)
+                continue
             job = self._materialize(jid, info)
             job.resumed = True
             job.replica = self.member.replica
             with self._lock:
                 self._jobs[jid] = job
                 if job.idempotency_key:
-                    self._idemp[job.idempotency_key] = jid
+                    self._idemp[
+                        (job.tenant, job.idempotency_key)
+                    ] = jid
             out.append(job)
         return out
+
+    def _quarantine_held(self, jid: str, info: dict) -> None:
+        """Quarantine a job THIS replica holds the lease for (the
+        restart-recovery budget branch): token-committed terminal,
+        journaled once, lease released, local copy updated."""
+        from repic_tpu.serve.jobs import quarantine_reason
+
+        if not self.member.quarantine(
+            jid, info, self.journal, path="recover"
+        ):
+            # a peer's commit won the race: adopt nothing — but the
+            # lease WE hold still points at a now-terminal job and
+            # would sit in the fleet dir forever; release it (the
+            # done token, not the lease, is the terminal authority)
+            self.member.release_lease(jid)
+            return
+        with self._lock:
+            job = self._jobs.get(jid)
+            if job is None:
+                job = self._materialize(jid, info)
+                self._jobs[jid] = job
+            job.state = JOB_QUARANTINED
+            job.reason = quarantine_reason(
+                int(info.get("runs", 0)),
+                self.member.reassign_budget,
+            )
+            job.finished_ts = self._clock()
+            self._note_terminal(jid)
+        self.member.release_lease(jid)
 
     # -- worker side --------------------------------------------------
 
@@ -836,6 +1075,14 @@ class FleetQueue:
             if lease is None or lease.get("replica") != (
                 self.member.replica
             ):
+                continue
+            if int(info.get("runs", 0)) > (
+                self.member.reassign_budget
+            ):
+                # a held job already over its attempt budget (e.g.
+                # freshly stolen leases race a peer's last running
+                # record): quarantine, never run
+                self._quarantine_held(jid, info)
                 continue
             return self._adopt_leased(jid, info, resumed=(
                 info["state"] == JOB_RUNNING
@@ -899,9 +1146,15 @@ class FleetQueue:
             _QUEUE_WAIT.observe(
                 max(job.started_ts - job.accepted_ts, 0.0)
             )
+        # rerun rides the journal exactly as in JobQueue: a
+        # same-process demotion is not a crashed generation, and
+        # the fleet_view `runs` fold must not bill the retry budget
+        # for it — or a twice-fallen-back healthy job would read
+        # over budget and be QUARANTINED at the next steal/claim
         self.journal.record(
             job.id, JOB_RUNNING, resumed=job.resumed,
             trace=job.trace_id,
+            **({"rerun": True} if rerun else {}),
         )
 
     def finish(self, job: Job, state: str, **fields) -> None:
@@ -955,6 +1208,8 @@ class FleetQueue:
                 job.id, state, trace=job.trace_id, **fields
             )
             _JOBS.inc(state=state)
+            if job.tenant is not None:
+                tenancy.note_job(job.tenant, state)
             self.member.release_lease(job.id)
             return
         # a fenced straggler losing the race: adopt the committed
@@ -983,7 +1238,9 @@ class FleetQueue:
         while len(self._terminal) > self.MAX_TERMINAL:
             evicted = self._jobs.pop(self._terminal.pop(0), None)
             if evicted is not None and evicted.idempotency_key:
-                self._idemp.pop(evicted.idempotency_key, None)
+                self._idemp.pop(
+                    (evicted.tenant, evicted.idempotency_key), None
+                )
 
     # -- client side --------------------------------------------------
 
@@ -1096,11 +1353,15 @@ class FleetQueue:
                 self.member.release_lease(job_id)
                 from repic_tpu.telemetry import server as tlm_server
 
-                tlm_server.observe_slo(
-                    "job",
-                    max(job.finished_ts - job.accepted_ts, 0.0),
-                    ok=False,
+                latency = max(
+                    job.finished_ts - job.accepted_ts, 0.0
                 )
+                tlm_server.observe_slo("job", latency, ok=False)
+                if job.tenant is not None:
+                    tlm_server.observe_slo(
+                        f"tenant:{job.tenant}", latency, ok=False
+                    )
+                    tenancy.note_job(job.tenant, JOB_CANCELLED)
                 return job
         # leased (or lost the claim race): cooperative, cross-replica
         with self._lock:

@@ -26,15 +26,20 @@ from dataclasses import dataclass, field
 from repic_tpu import telemetry
 from repic_tpu.runtime import faults
 from repic_tpu.runtime.journal import _read_entries, error_info
+from repic_tpu.serve import tenancy
 from repic_tpu.telemetry import server as tlm_server
 from repic_tpu.telemetry import trace as tlm_trace
 
 SERVE_JOURNAL_NAME = "_serve_journal.jsonl"
 
 #: exit status of a ``server_crash`` fault firing — distinguishable
-#: from ordinary failures (and from the cluster's host_crash 23) in
-#: the chaos test harness
+#: from the cluster's host_crash (23) in the chaos test harness
 SERVE_CRASH_EXIT_CODE = 24
+#: exit status of a ``poison_job`` fault firing: the deterministic
+#: input-keyed worker crash the quarantine budget exists to contain
+#: (distinct from 24/25 so the chaos harness can tell a generic
+#: daemon loss from a poison-pill kill)
+POISON_CRASH_EXIT_CODE = 26
 
 JOB_QUEUED = "queued"
 JOB_RUNNING = "running"
@@ -42,10 +47,20 @@ JOB_FINISHED = "finished"
 JOB_FAILED = "failed"
 JOB_CANCELLED = "cancelled"
 JOB_DEADLINE_EXCEEDED = "deadline_exceeded"
+#: terminal containment state: the job's input deterministically
+#: kills its worker, and its retry budget is spent — never re-run,
+#: full provenance in the journal (docs/serving.md "quarantine")
+JOB_QUARANTINED = "quarantined"
 
 TERMINAL_STATES = frozenset(
-    (JOB_FINISHED, JOB_FAILED, JOB_CANCELLED, JOB_DEADLINE_EXCEEDED)
+    (JOB_FINISHED, JOB_FAILED, JOB_CANCELLED, JOB_DEADLINE_EXCEEDED,
+     JOB_QUARANTINED)
 )
+
+#: default per-job retry budget: a job may be (re)started at most
+#: budget + 1 times across the fleet (lease steals after a replica
+#: loss, and same-replica crash-recovery re-runs, both count)
+DEFAULT_REASSIGN_BUDGET = 2
 
 _REJECTED = telemetry.counter(
     "repic_serve_rejected_total",
@@ -92,6 +107,10 @@ _QUEUE_WAIT = telemetry.histogram(
     "repic_serve_queue_wait_seconds",
     "seconds an accepted job waited in the queue before running",
 )
+_QUARANTINED = telemetry.counter(
+    "repic_serve_quarantined_jobs_total",
+    "jobs quarantined over their retry budget (by decision path)",
+)
 
 
 def crash_point(point: str) -> None:
@@ -102,6 +121,29 @@ def crash_point(point: str) -> None:
     ``finish:<job>``."""
     if faults.check("server_crash", point):
         os._exit(SERVE_CRASH_EXIT_CODE)
+
+
+def quarantine_reason(attempts: int, budget: int) -> str:
+    """The ONE wording of the quarantine verdict (journal records,
+    job documents, logs) — three call sites, zero drift."""
+    return (
+        f"poison-job quarantine: {attempts} crashed attempt(s) "
+        f"exceed the retry budget ({budget})"
+    )
+
+
+def poison_point(job_id: str, key: str = "") -> None:
+    """``poison_job`` fault site: the deterministic poison pill.
+
+    Polled by the worker right after it binds a job to its input —
+    a firing kills the process (``os._exit(26)``, no lease release,
+    no journal close) EVERY time any worker attempts the job, which
+    is what makes the input a poison pill rather than a transient
+    crash.  The call-site key is ``<job_id>:<in_dir>``, so plans key
+    on the input directory (``poison_job:<dir-substring>:inf``) —
+    the job id is minted server-side and unknown to the plan."""
+    if faults.check("poison_job", f"{job_id}:{key}"):
+        os._exit(POISON_CRASH_EXIT_CODE)
 
 
 class AdmissionError(Exception):
@@ -127,9 +169,11 @@ class Job:
     request: dict                  # validated submission payload
     accepted_ts: float
     state: str = JOB_QUEUED
+    tenant: str | None = None      # authenticated owner (tenancy.py)
     trace_id: str | None = None    # request-scoped tracing key
     idempotency_key: str | None = None  # client retry dedupe handle
     replica: str | None = None     # fleet: replica that ran/runs it
+    attempts: int = 0              # journaled run starts (budget)
     deadline_ts: float | None = None
     bucket_hint: int | None = None
     micrographs: int | None = None  # admission-time size estimate
@@ -155,8 +199,12 @@ class Job:
             "finished_ts": self.finished_ts,
             "resumed": self.resumed,
         }
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
         if self.trace_id is not None:
             out["trace_id"] = self.trace_id
+        if self.attempts:
+            out["attempts"] = self.attempts
         if self.idempotency_key is not None:
             out["idempotency_key"] = self.idempotency_key
         if self.replica is not None:
@@ -261,6 +309,7 @@ class ServeJournal:
         latest: dict[str, dict] = {}
         payload: dict[str, dict] = {}
         cancel_req: set[str] = set()
+        runs: dict[str, int] = {}
         order: list[str] = []
         for e in _read_entries(self.path):
             jid = e.get("job")
@@ -271,6 +320,18 @@ class ServeJournal:
                 payload[jid] = e
             if e.get("cancel_requested"):
                 cancel_req.add(jid)
+            if (
+                "event" not in e
+                and e.get("state") == JOB_RUNNING
+                and not e.get("cancel_requested")
+                and not e.get("rerun")
+            ):
+                # every journaled run START counts toward the
+                # poison-job retry budget: one per generation that
+                # crashed mid-job.  Cancel-flag and same-process
+                # rerun records are bookkeeping, not new attempts
+                # (same rule as the fleet view's `runs` fold).
+                runs[jid] = runs.get(jid, 0) + 1
             latest[jid] = e
         out = []
         for jid in order:
@@ -282,6 +343,7 @@ class ServeJournal:
                 id=jid,
                 request=first.get("request", {}),
                 accepted_ts=float(first.get("ts", time.time())),
+                tenant=first.get("tenant"),
                 # the original accept's trace id survives the crash:
                 # the re-run's spans/segments join the same request
                 trace_id=first.get("trace"),
@@ -290,12 +352,138 @@ class ServeJournal:
                 bucket_hint=first.get("bucket_hint"),
                 micrographs=first.get("micrographs"),
                 resumed=state == JOB_RUNNING,
+                attempts=runs.get(jid, 0),
                 # an acknowledged running-job cancel survives the
                 # crash: the re-run stops at its first cancel poll
                 cancel_requested=jid in cancel_req,
             )
             out.append(job)
         return out
+
+    def compact(self, max_terminal: int = 512,
+                max_events: int = 256,
+                terminal_ids=None) -> dict | None:
+        """Bound journal growth: fold old terminal jobs to one line.
+
+        A long-lived daemon appends 3+ records per job forever; this
+        rewrites the file (atomic tmp+replace) keeping
+
+        * every record of every NON-terminal job verbatim — the
+          journal-before-202 durability promise is untouchable;
+        * every record of the newest ``max_terminal`` terminal jobs
+          verbatim (the in-memory addressability window);
+        * ONE folded record per older terminal job — its latest
+          terminal record (state, ts, trace, reason/error/result
+          tallies) plus the accept's ``idempotency_key``/``tenant``
+          so fleet-wide retry dedupe and attribution survive the
+          fold; the bulky ``request`` payload is dropped;
+        * events referencing retained jobs, plus the newest
+          ``max_events`` job-less events.
+
+        Call only while the journal is closed (startup before
+        recovery, or after a clean drain): the single-writer promise
+        must hold across the replace.  Returns a stats dict, or
+        ``None`` when there was nothing to fold (the file is left
+        byte-identical — no rewrite per restart).  Torn trailing
+        lines are dropped exactly as :func:`recover` drops them.
+
+        ``terminal_ids``: extra job ids known terminal from OUTSIDE
+        this file — fleet mode passes the merged-view terminal set,
+        because a job accepted here routinely finishes on a peer
+        (its terminal record lives in the peer's journal) and would
+        otherwise never fold out of the acceptor's file.  Folding
+        such a job keeps its LAST local record (ts intact), so the
+        peer's terminal record still wins the merged fold.
+        """
+        import json
+
+        from repic_tpu.runtime.atomic import atomic_write
+
+        with self._lock:
+            if self._fh is not None:
+                raise RuntimeError(
+                    "compact() requires a closed journal"
+                )
+        entries = _read_entries(self.path)
+        if not entries:
+            return None
+        per_job: dict[str, list[dict]] = {}
+        events: list[dict] = []
+        for e in entries:
+            jid = e.get("job")
+            if jid and "event" not in e:
+                per_job.setdefault(jid, []).append(e)
+            else:
+                events.append(e)
+        known_terminal = frozenset(terminal_ids or ())
+        terminal = [
+            (float(recs[-1].get("ts", 0.0)), jid)
+            for jid, recs in per_job.items()
+            if recs[-1].get("state") in TERMINAL_STATES
+            or jid in known_terminal
+        ]
+        terminal.sort()
+        fold = {jid for _, jid in terminal[:-max_terminal]} if (
+            len(terminal) > max_terminal
+        ) else set()
+        # a job already reduced to its one folded record is done —
+        # without this, every restart would re-count it as work and
+        # rewrite an unchanged journal forever
+        fold = {
+            jid
+            for jid in fold
+            if not (
+                len(per_job[jid]) == 1
+                and per_job[jid][0].get("folded")
+            )
+        }
+        job_events = [e for e in events if e.get("job")]
+        bare_events = [e for e in events if not e.get("job")]
+        dropped_events = (
+            sum(1 for e in job_events if e["job"] in fold)
+            + max(len(bare_events) - max_events, 0)
+        )
+        if not fold and not dropped_events:
+            return None
+        out: list[dict] = []
+        folded = 0
+        for jid, recs in per_job.items():
+            if jid not in fold:
+                out.extend(recs)
+                continue
+            last = {
+                k: v for k, v in recs[-1].items() if k != "request"
+            }
+            first = recs[0]
+            for carry in ("idempotency_key", "tenant"):
+                if carry in first and carry not in last:
+                    last[carry] = first[carry]
+            last["folded"] = True
+            out.append(last)
+            folded += 1
+        out.extend(
+            e for e in job_events if e["job"] not in fold
+        )
+        kept_bare = bare_events[-max_events:] if max_events else []
+        out.extend(kept_bare)
+        stats = {
+            "folded": folded,
+            "kept_jobs": len(per_job) - folded,
+            "dropped_events": dropped_events,
+        }
+        # the marker both journals the compaction in-band and
+        # guarantees the rewritten file's SIZE changes, so peers'
+        # size-keyed incremental readers re-parse it
+        marker = {"event": "journal_compacted", "ts": time.time()}
+        if self.replica:
+            marker["replica"] = self.replica
+        marker.update(stats)
+        out.append(marker)
+        out.sort(key=lambda e: float(e.get("ts", 0.0)))
+        with atomic_write(self.path) as f:
+            for e in out:
+                f.write(json.dumps(e) + "\n")
+        return stats
 
 
 class CircuitBreaker:
@@ -310,6 +498,20 @@ class CircuitBreaker:
     closes it, failure re-opens it for another cooldown.  This is
     the standard overload-protection shape (release the retry storm
     against a broken dependency only gradually).
+
+    **Tenant scoping (blast-radius containment).**  With tenancy
+    configured, failures carry the owning tenant, and each named
+    tenant gets its OWN streak + open/half-open state: a tenant
+    whose jobs keep failing is 503'd (``tenant_circuit_open``)
+    while everyone else submits freely.  The SHARED breaker — the
+    one that refuses everybody — only trips when at least TWO
+    tenants each reach the threshold on their own streak (a broken
+    backend fails everyone quickly; a poisoned input fails one
+    tenant, and a stray failure from a second tenant must not
+    convert that one tenant's streak into a fleet-wide 503).
+    Failures without a tenant (no ``--tenants`` file) keep today's
+    single-tenant behavior exactly: every failure feeds the shared
+    breaker.
     """
 
     CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
@@ -325,6 +527,8 @@ class CircuitBreaker:
         self.state = self.CLOSED
         self.failures = 0
         self.opened_ts: float | None = None
+        #: per-tenant state machines (lazily created on failure)
+        self._tenant: dict[str, dict] = {}
         _BREAKER_STATE.set(0)
         _BREAKER_FAILURES.set(0)
 
@@ -334,35 +538,84 @@ class CircuitBreaker:
             {self.CLOSED: 0, self.OPEN: 1, self.HALF_OPEN: 2}[state]
         )
 
-    def check_admission(self) -> None:
-        """Raise :class:`AdmissionError` (503) while open."""
+    def _tenant_slot(self, tenant: str) -> dict:
+        slot = self._tenant.get(tenant)
+        if slot is None:
+            slot = self._tenant[tenant] = {
+                "state": self.CLOSED,
+                "failures": 0,
+                "opened_ts": 0.0,
+            }
+        return slot
+
+    def check_admission(self, tenant: str | None = None) -> None:
+        """Raise :class:`AdmissionError` (503) while open — the
+        shared breaker first, then the submitting tenant's own."""
         with self._lock:
-            if self.state != self.OPEN:
-                return
-            elapsed = self._clock() - (self.opened_ts or 0.0)
-            if elapsed >= self.cooldown_s:
+            if self.state == self.OPEN:
+                elapsed = self._clock() - (self.opened_ts or 0.0)
+                if elapsed < self.cooldown_s:
+                    raise AdmissionError(
+                        503,
+                        "circuit_open",
+                        self.cooldown_s - elapsed,
+                    )
                 self._set_state(self.HALF_OPEN)
+            if tenant is None:
+                return
+            slot = self._tenant.get(tenant)
+            if slot is None or slot["state"] != self.OPEN:
+                return
+            elapsed = self._clock() - slot["opened_ts"]
+            if elapsed >= self.cooldown_s:
+                slot["state"] = self.HALF_OPEN
                 return
             raise AdmissionError(
                 503,
-                "circuit_open",
+                "tenant_circuit_open",
                 self.cooldown_s - elapsed,
             )
 
-    def record_success(self) -> None:
+    def record_success(self, tenant: str | None = None) -> None:
         with self._lock:
             self.failures = 0
             _BREAKER_FAILURES.set(0)
             self._set_state(self.CLOSED)
+            if tenant is not None:
+                self._tenant.pop(tenant, None)
 
-    def record_failure(self) -> None:
+    def record_failure(self, tenant: str | None = None) -> None:
         with self._lock:
             self.failures += 1
             _BREAKER_FAILURES.set(self.failures)
-            if (
-                self.state == self.HALF_OPEN
-                or self.failures >= self.threshold
-            ):
+            if tenant is not None:
+                slot = self._tenant_slot(tenant)
+                slot["failures"] += 1
+                if (
+                    slot["state"] == self.HALF_OPEN
+                    or slot["failures"] >= self.threshold
+                ):
+                    if slot["state"] != self.OPEN:
+                        _BREAKER_TRIPS.inc()
+                    slot["state"] = self.OPEN
+                    slot["opened_ts"] = self._clock()
+            if tenant is None:
+                # legacy single-tenant mode: every failure feeds the
+                # shared streak directly
+                shared_eligible = self.failures >= self.threshold
+            else:
+                # the shared breaker needs TWO tenants each at the
+                # threshold on their own — one stray failure from
+                # tenant B must not convert tenant A's poison
+                # streak into a fleet-wide 503 (A's 20 failures +
+                # B's 1 is A's problem, not the backend's)
+                at_threshold = sum(
+                    1
+                    for s in self._tenant.values()
+                    if s["failures"] >= self.threshold
+                )
+                shared_eligible = at_threshold >= 2
+            if self.state == self.HALF_OPEN or shared_eligible:
                 if self.state != self.OPEN:
                     _BREAKER_TRIPS.inc()
                 self._set_state(self.OPEN)
@@ -373,7 +626,9 @@ class CircuitBreaker:
         open — how long until the half-open probe window.  The same
         numbers ride on /metrics (`repic_serve_breaker_state`,
         `repic_serve_breaker_failures`), so a tripped breaker is
-        visible on both surfaces instead of silently eating jobs."""
+        visible on both surfaces instead of silently eating jobs.
+        With tenancy configured, a ``tenants`` sub-section carries
+        every tenant with a live streak or an open breaker."""
         with self._lock:
             out = {
                 "state": self.state,
@@ -385,6 +640,20 @@ class CircuitBreaker:
                 out["cooldown_remaining_s"] = round(
                     max(self.cooldown_s - elapsed, 0.0), 3
                 )
+            tenants = {}
+            for name, slot in sorted(self._tenant.items()):
+                entry = {
+                    "state": slot["state"],
+                    "consecutive_failures": slot["failures"],
+                }
+                if slot["state"] == self.OPEN:
+                    elapsed = self._clock() - slot["opened_ts"]
+                    entry["cooldown_remaining_s"] = round(
+                        max(self.cooldown_s - elapsed, 0.0), 3
+                    )
+                tenants[name] = entry
+            if tenants:
+                out["tenants"] = tenants
             return out
 
 
@@ -421,6 +690,7 @@ class JobQueue:
         journal: ServeJournal,
         breaker: CircuitBreaker | None = None,
         *,
+        tenants: "tenancy.TenantRegistry | None" = None,
         clock=time.time,
     ):
         if limit < 1:
@@ -428,13 +698,17 @@ class JobQueue:
         self.limit = limit
         self.journal = journal
         self.breaker = breaker or CircuitBreaker()
+        self.tenants = tenants
         self._clock = clock
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._jobs: dict[str, Job] = {}
         self._pending: list[str] = []
         self._terminal: list[str] = []  # completion order (eviction)
-        self._idemp: dict[str, str] = {}  # idempotency key -> job id
+        # (tenant, idempotency key) -> job id: keys are scoped PER
+        # TENANT so one tenant's retry can never collide into (and
+        # leak) another tenant's job
+        self._idemp: dict[tuple, str] = {}
         # the continuous batcher holds several jobs open at once, so
         # "running" is a set, not a slot (the single-job scheduler is
         # simply the |set| <= 1 case)
@@ -456,6 +730,7 @@ class JobQueue:
         bucket_hint: int | None = None,
         idempotency_key: str | None = None,
         micrographs: int | None = None,
+        tenant: str | None = None,
     ) -> Job:
         """Admit one request or raise :class:`AdmissionError`."""
         return self.submit_idempotent(
@@ -464,13 +739,14 @@ class JobQueue:
             bucket_hint=bucket_hint,
             idempotency_key=idempotency_key,
             micrographs=micrographs,
+            tenant=tenant,
         )[0]
 
-    def _lookup_idempotent(self, key: str | None) -> Job | None:
+    def _lookup_idempotent(self, tenant, key) -> Job | None:
         if not key:
             return None
         with self._lock:
-            jid = self._idemp.get(key)
+            jid = self._idemp.get((tenant, key))
             return self._jobs.get(jid) if jid else None
 
     def submit_idempotent(
@@ -481,6 +757,7 @@ class JobQueue:
         bucket_hint: int | None = None,
         idempotency_key: str | None = None,
         micrographs: int | None = None,
+        tenant: str | None = None,
     ) -> tuple[Job, bool]:
         """:meth:`submit`, returning ``(job, deduped)``.
 
@@ -498,7 +775,7 @@ class JobQueue:
         still pays it — the backlog check needs the lock, and
         listing must not run under it.)
         """
-        existing = self._lookup_idempotent(idempotency_key)
+        existing = self._lookup_idempotent(tenant, idempotency_key)
         if existing is not None:
             _DEDUPED.inc()
             return existing, True
@@ -509,11 +786,11 @@ class JobQueue:
             )
             raise AdmissionError(503, "draining", 30.0)
         try:
-            self.breaker.check_admission()
-        except AdmissionError:
-            _REJECTED.inc(reason="circuit_open")
+            self.breaker.check_admission(tenant)
+        except AdmissionError as e:
+            _REJECTED.inc(reason=e.reason)
             _ADMISSION.inc(
-                outcome="rejected", cause="circuit_open", code="503"
+                outcome="rejected", cause=e.reason, code="503"
             )
             raise
         if callable(micrographs):
@@ -521,8 +798,9 @@ class JobQueue:
         with self._lock:
             # re-check under the creation lock: two concurrent
             # retries with one key must still yield one job
-            if idempotency_key and idempotency_key in self._idemp:
-                job = self._jobs.get(self._idemp[idempotency_key])
+            if idempotency_key:
+                jid = self._idemp.get((tenant, idempotency_key))
+                job = self._jobs.get(jid) if jid else None
                 if job is not None:
                     _DEDUPED.inc()
                     return job, True
@@ -539,11 +817,42 @@ class JobQueue:
                     "queue_full",
                     self._retry_after_s(max(backlog, 1)),
                 )
+            # tenant limits live in the SAME critical section as the
+            # queue-full 429 (the admission decision must be atomic
+            # with the insert), with their own cause labels so a
+            # dashboard can tell fleet overload from tenant overage
+            if self.tenants is not None and tenant is not None:
+                open_jobs, queued_mics = (
+                    self._tenant_tallies_locked(tenant)
+                )
+                refused = self.tenants.check_admission(
+                    tenant,
+                    micrographs=micrographs or 1,
+                    open_jobs=open_jobs,
+                    queued_micrographs=queued_mics,
+                    per_mic_s=self._avg_mic_s,
+                )
+                if refused is not None:
+                    cause, retry_after = refused
+                    # a job intrinsically over the quota can NEVER
+                    # be admitted: permanent 413, not a 429 a
+                    # polite client would replay forever
+                    code = (
+                        413 if cause == "tenant_job_too_large"
+                        else 429
+                    )
+                    _REJECTED.inc(reason=cause)
+                    _ADMISSION.inc(
+                        outcome="rejected", cause=cause,
+                        code=str(code),
+                    )
+                    raise AdmissionError(code, cause, retry_after)
             now = self._clock()
             job = Job(
                 id=new_job_id(),
                 request=request,
                 accepted_ts=now,
+                tenant=tenant,
                 # the trace id is minted AT ACCEPT: queue residency,
                 # execution, and emit all join back to this moment
                 trace_id=tlm_trace.new_trace_id(),
@@ -565,6 +874,8 @@ class JobQueue:
             )
             if micrographs is not None:
                 extra["micrographs"] = micrographs
+            if tenant is not None:
+                extra["tenant"] = tenant
             self.journal.record(
                 job.id,
                 JOB_QUEUED,
@@ -577,15 +888,58 @@ class JobQueue:
             self._jobs[job.id] = job
             self._pending.append(job.id)
             if idempotency_key:
-                self._idemp[idempotency_key] = job.id
+                self._idemp[(tenant, idempotency_key)] = job.id
             _DEPTH.set(len(self._pending))
         _ADMITTED.inc()
         _ADMISSION.inc(
             outcome="accepted", cause="accepted", code="202"
         )
+        if tenant is not None:
+            tenancy.note_admitted(tenant)
         crash_point(f"accept:{job.id}")
         self._wake.set()
         return job, False
+
+    def _tenant_tallies_locked(self, tenant: str) -> tuple[int, int]:
+        """(open jobs, queued micrographs) for one tenant — call
+        with the queue lock held (quota inputs must be consistent
+        with the insert that follows)."""
+        open_jobs = 0
+        queued_mics = 0
+        for jid in self._pending:
+            j = self._jobs.get(jid)
+            if j is not None and j.tenant == tenant:
+                open_jobs += 1
+                queued_mics += j.micrographs or 1
+        for jid in self._running:
+            j = self._jobs.get(jid)
+            if j is not None and j.tenant == tenant:
+                open_jobs += 1
+        return open_jobs, queued_mics
+
+    def tenant_tallies(self) -> dict[str, dict]:
+        """Per-tenant open-job / queued-micrograph tallies (the
+        /status ``tenants`` section and the repic_tenant_* gauges)."""
+        out: dict[str, dict] = {}
+        with self._lock:
+            live = [
+                (self._jobs.get(jid), True)
+                for jid in self._pending
+            ] + [
+                (self._jobs.get(jid), False)
+                for jid in self._running
+            ]
+        for job, queued in live:
+            if job is None or job.tenant is None:
+                continue
+            slot = out.setdefault(
+                job.tenant,
+                {"open_jobs": 0, "queued_micrographs": 0},
+            )
+            slot["open_jobs"] += 1
+            if queued:
+                slot["queued_micrographs"] += job.micrographs or 1
+        return out
 
     def _queued_micrographs(self) -> int:
         """Backlog size in MICROGRAPHS (call with the lock held):
@@ -609,17 +963,24 @@ class JobQueue:
         mics = max(self._queued_micrographs(), backlog, 1)
         return self._avg_mic_s * mics
 
-    def adopt(self, job: Job) -> None:
+    def adopt(self, job: Job, runnable: bool = True) -> None:
         """Re-queue a recovered job (daemon restart) — no admission
         checks and no re-journaling of the accept: the previous
-        generation already made the durability promise."""
+        generation already made the durability promise.
+        ``runnable=False`` registers the job as addressable (GET,
+        idempotent retry) without scheduling it — the quarantine
+        path, which marks it terminal immediately after."""
         with self._lock:
             self._jobs[job.id] = job
-            self._pending.append(job.id)
+            if runnable:
+                self._pending.append(job.id)
             if job.idempotency_key:
-                self._idemp[job.idempotency_key] = job.id
+                self._idemp[(job.tenant, job.idempotency_key)] = (
+                    job.id
+                )
             _DEPTH.set(len(self._pending))
-        self._wake.set()
+        if runnable:
+            self._wake.set()
 
     # -- worker side --------------------------------------------------
 
@@ -692,6 +1053,8 @@ class JobQueue:
         )
         if state in TERMINAL_STATES:
             _JOBS.inc(state=state)
+            if job.tenant is not None:
+                tenancy.note_job(job.tenant, state)
 
     def _note_terminal(self, job_id: str) -> None:
         """Bound in-memory job history (call with the lock held)."""
@@ -702,7 +1065,9 @@ class JobQueue:
                 # a dangling index entry would alias a NEW submission
                 # onto the evicted id; dedupe history is bounded by
                 # the same cap as the job map
-                self._idemp.pop(evicted.idempotency_key, None)
+                self._idemp.pop(
+                    (evicted.tenant, evicted.idempotency_key), None
+                )
 
     def mark_failed(self, job: Job) -> None:
         """Last-resort state flip when :meth:`finish` itself failed
@@ -730,9 +1095,13 @@ class JobQueue:
             _QUEUE_WAIT.observe(
                 max(job.started_ts - job.accepted_ts, 0.0)
             )
+        # the rerun flag ALSO rides the journal: a same-process
+        # demotion is not a crashed generation, so the retry-budget
+        # run counts (recover / fleet_view) must not bill it
         self.journal.record(
             job.id, JOB_RUNNING, resumed=job.resumed,
             trace=job.trace_id,
+            **({"rerun": True} if rerun else {}),
         )
 
     # -- client side --------------------------------------------------
@@ -803,11 +1172,13 @@ class JobQueue:
             # daemon's _finish_job, so the SLO plane must hear about
             # it here — docs/serving.md: cancelled jobs count as
             # violations (the client did not get a timely success)
-            tlm_server.observe_slo(
-                "job",
-                max(job.finished_ts - job.accepted_ts, 0.0),
-                ok=False,
-            )
+            latency = max(job.finished_ts - job.accepted_ts, 0.0)
+            tlm_server.observe_slo("job", latency, ok=False)
+            if job.tenant is not None:
+                tlm_server.observe_slo(
+                    f"tenant:{job.tenant}", latency, ok=False
+                )
+                tenancy.note_job(job.tenant, JOB_CANCELLED)
         return job
 
     def begin_drain(self) -> int:

@@ -181,6 +181,12 @@ class SLOTracker:
         for endpoint in sorted(by_endpoint):
             slot = by_endpoint[endpoint]
             objective = self.objectives.get(endpoint)
+            if objective is None and endpoint.startswith("tenant:"):
+                # per-tenant job buckets (serve tenancy) inherit the
+                # `job` objective: one --slo-target job=... yields a
+                # compliance/burn readout PER TENANT, so one
+                # tenant's throttling is visibly not another's SLO
+                objective = self.objectives.get("job")
             entry = self._stats(slot["all"], objective)
             if slot["buckets"]:
                 entry["by_bucket"] = {

@@ -207,6 +207,94 @@ def test_lease_steal_fault_loses_the_race(tmp_path):
     assert b.lease_info(jid)["replica"] == "b"
 
 
+def test_steal_budget_quarantines_poison_job(tmp_path):
+    """ISSUE 14: the lease-steal is where a poison pill would
+    propagate, so the retry budget is checked there.  A job whose
+    journaled run attempts already exceed the budget is NOT stolen
+    — the fence winner commits it terminal ``quarantined`` through
+    the exactly-once token, with one terminal record, and the job
+    can never be claimed again."""
+    clk = Clock()
+    a = _member(tmp_path, "a", clk)
+    ja = ServeJournal(str(tmp_path), replica="a")
+    ja.record("job-poison", JOB_QUEUED, request=REQ, trace="tp",
+              tenant="teamA")
+    # two journaled run attempts (original + one failover re-run):
+    # over a budget of 1
+    ja.record("job-poison", JOB_RUNNING, trace="tp")
+    ja.record("job-poison", JOB_RUNNING, resumed=True, trace="tp")
+    ja.close()
+    assert a.lease_job("job-poison")
+    clk.advance(5.0)  # a dies
+    b = _member(tmp_path, "b", clk)
+    b.reassign_budget = 1
+    qb = _queue(tmp_path, b)
+    stolen = b.harvest(qb.fleet_view(), qb.journal)
+    assert stolen == []  # quarantined, not stolen
+    # exactly-once: the completion token carries the state
+    done = b.read_done("job-poison")
+    assert done["state"] == "quarantined"
+    assert done["attempts"] == 2
+    # the lease still names the dead replica (never rewritten), but
+    # the token forecloses scheduling it anywhere
+    assert b.lease_info("job-poison")["replica"] == "a"
+    assert qb.next_job(0.05) is None
+    records = _all_state_records(tmp_path, "job-poison")
+    terminal = [
+        r for r in records if r["state"] in TERMINAL_STATES
+    ]
+    assert len(terminal) == 1
+    assert terminal[0]["state"] == "quarantined"
+    assert terminal[0]["trace"] == "tp"
+    assert "retry budget" in terminal[0]["reason"]
+    # any replica answers GET with the quarantined materialization
+    job = qb.get("job-poison")
+    assert job.state == "quarantined"
+    assert job.tenant == "teamA"
+    # a second harvest round has nothing left to do
+    assert b.harvest(qb.fleet_view(), qb.journal) == []
+
+
+def test_steal_within_budget_still_steals(tmp_path):
+    """One prior run attempt is within the default budget (2): the
+    steal proceeds exactly as before ISSUE 14."""
+    clk = Clock()
+    b, qb, jid = _orphan_setup(tmp_path, clk)
+    assert b.reassign_budget == 2
+    assert b.harvest(qb.fleet_view(), qb.journal) == [jid]
+    assert b.read_done(jid) is None
+
+
+def test_recover_own_quarantines_over_budget(tmp_path):
+    """The restart-recovery half: a replica restarting under the
+    same id, still holding the lease of a job that crashed it
+    repeatedly, quarantines it instead of re-running into the same
+    crash — and releases its lease."""
+    clk = Clock()
+    a = _member(tmp_path, "a", clk)
+    ja = ServeJournal(str(tmp_path), replica="a")
+    ja.record("job-own", JOB_QUEUED, request=REQ, trace="to")
+    for _ in range(2):
+        ja.record("job-own", JOB_RUNNING, trace="to")
+    ja.close()
+    assert a.lease_job("job-own")
+    # "restart": a fresh member under the same id
+    a2 = _member(tmp_path, "a", clk)
+    a2.reassign_budget = 1
+    qa2 = _queue(tmp_path, a2)
+    assert qa2.recover_own() == []
+    done = a2.read_done("job-own")
+    assert done["state"] == "quarantined"
+    assert a2.lease_info("job-own") is None  # lease released
+    assert qa2.get("job-own").state == "quarantined"
+    terminal = [
+        r
+        for r in _all_state_records(tmp_path, "job-own")
+        if r["state"] in TERMINAL_STATES
+    ]
+    assert len(terminal) == 1
+
+
 def test_harvest_leaves_live_replicas_alone(tmp_path):
     clk = Clock()
     a = _member(tmp_path, "a", clk)

@@ -37,7 +37,7 @@ FIXTURE = os.path.join(
 
 def _spawn_replica(
     fleet, wd, rid, hb="0.2", timeout="1.0", extra_env=None,
-    warmup=False,
+    warmup=False, extra_args=(),
 ):
     os.makedirs(wd, exist_ok=True)
     env = dict(
@@ -57,6 +57,7 @@ def _spawn_replica(
             "--fleet-dir", fleet,
             "--heartbeat-interval", hb,
             "--replica-timeout", timeout,
+            *extra_args,
         ],
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
@@ -388,6 +389,220 @@ def test_replica_crash_fault_exits_25_and_survivor_finishes(
         assert warmups[-1]["persistent_cache_hits"] >= 1, (
             warmups[-1]
         )
+    finally:
+        _kill_all(procs)
+
+
+@pytest.mark.faults
+def test_poison_job_quarantined_within_budget_fleet(tmp_path):
+    """The ISSUE 14 poison-pill acceptance gate, over real
+    processes: a 3-replica fleet where ONE tenant's job carries a
+    deterministic worker-killing input (``poison_job`` fault keyed
+    on its in_dir, every attempt).  Without the budget the pill
+    would serially kill every replica; with ``--reassign-budget 1``
+    it kills at most budget+1 of them, the next fence winner
+    commits terminal ``quarantined`` through the exactly-once
+    token (≤ 1 reassignment, exactly one terminal record), at
+    least one replica stays live — and the OTHER tenant's
+    concurrent job completes with byte-identical artifacts vs an
+    undisturbed control run, through a breaker the poison never
+    opened.  ``--scheduler single`` keeps each replica holding one
+    lease at a time, so the bystander job can never ride a
+    poison-crashing worker's open set.
+    """
+    import shutil
+
+    from repic_tpu.serve.jobs import TERMINAL_STATES as TS
+
+    fleet = str(tmp_path / "fleet")
+    # the poison input: a real, valid directory — only the fault
+    # plan (keyed on the directory name) makes it lethal
+    poison_dir = str(tmp_path / "poison_input")
+    shutil.copytree(FIXTURE, poison_dir)
+    tenants = tmp_path / "tenants.json"
+    tenants.write_text(json.dumps({
+        "tenants": [
+            {"name": "teamA", "keys": ["ka"]},
+            {"name": "teamB", "keys": ["kb"]},
+        ]
+    }))
+    args = [
+        "--scheduler", "single",
+        "--reassign-budget", "1",
+        "--tenants", str(tenants),
+    ]
+    env = {"REPIC_TPU_FAULTS": "poison_job:poison_input:inf"}
+    procs, ports = {}, {}
+    for rid in ("r1", "r2", "r3"):
+        procs[rid] = _spawn_replica(
+            fleet, str(tmp_path / f"wd_{rid}"), rid,
+            extra_env=env, extra_args=args,
+        )
+    try:
+        for rid, p in procs.items():
+            ports[rid] = _wait_port(str(tmp_path / f"wd_{rid}"), p)
+
+        def req_auth(port, method, path, body=None, key=None):
+            import urllib.error
+            import urllib.request
+
+            r = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                method=method,
+                data=(
+                    json.dumps(body).encode()
+                    if body is not None else None
+                ),
+                headers=(
+                    {"Authorization": f"Bearer {key}"}
+                    if key else {}
+                ),
+            )
+            try:
+                with urllib.request.urlopen(r, timeout=30) as resp:
+                    return resp.status, resp.read().decode()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read().decode()
+
+        normal = {
+            "in_dir": os.path.abspath(FIXTURE),
+            "box_size": 180,
+            "options": {"use_mesh": False},
+        }
+        poison = dict(normal, in_dir=os.path.abspath(poison_dir))
+        # tenant B's innocent job AND tenant A's poison pill, in
+        # flight concurrently on the same fleet
+        code, body = req_auth(
+            ports["r1"], "POST", "/v1/jobs", normal, key="kb"
+        )
+        assert code == 202, body
+        b_jid = json.loads(body)["id"]
+        import http.client
+
+        p_jid = None
+        try:
+            code, body = req_auth(
+                ports["r1"], "POST", "/v1/jobs", poison, key="ka"
+            )
+            assert code == 202, body
+            p_jid = json.loads(body)["id"]
+        except (http.client.HTTPException, OSError):
+            # r1's own worker can claim the pill and die while the
+            # 202 is in flight; the accept record is already
+            # durable (journal-before-202), so read the id back
+            deadline = time.time() + 60
+            while p_jid is None and time.time() < deadline:
+                for e in _fleet_journal_entries(fleet):
+                    if (
+                        e.get("state") == "queued"
+                        and e.get("tenant") == "teamA"
+                    ):
+                        p_jid = e["job"]
+                        break
+                time.sleep(0.1)
+            assert p_jid, "poison accept record never journaled"
+        # wait for the quarantine token: the pill kills its first
+        # runner, one survivor steals (reassignment #1) and dies,
+        # the next fence winner quarantines instead of running
+        done_path_ = os.path.join(fleet, f"_done.{p_jid}.json")
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if os.path.exists(done_path_):
+                break
+            assert any(
+                p.poll() is None for p in procs.values()
+            ), "every replica died — the budget did not contain it"
+            time.sleep(0.2)
+        done = json.load(open(done_path_))
+        assert done["state"] == "quarantined", done
+        # blast radius bounded at budget+1 replicas; >= 1 live
+        live = [r for r, p in procs.items() if p.poll() is None]
+        dead = [r for r, p in procs.items() if p.poll() is not None]
+        assert len(dead) <= 2, dead
+        assert live, "no surviving replica"
+        for r in dead:
+            assert procs[r].returncode == 26, (  # poison exit code
+                r, procs[r].returncode
+            )
+        port = ports[live[0]]
+        # the survivor answers for the quarantined job — for its
+        # OWNING tenant only
+        code, body = req_auth(
+            port, "GET", f"/v1/jobs/{p_jid}", key="ka"
+        )
+        assert code == 200, body
+        doc = json.loads(body)
+        assert doc["state"] == "quarantined", doc
+        assert doc["tenant"] == "teamA"
+        assert "retry budget" in doc["reason"]
+        code, _ = req_auth(
+            port, "GET", f"/v1/jobs/{p_jid}", key="kb"
+        )
+        assert code == 403
+        # exactly one terminal record, <= budget reassignments
+        entries = _fleet_journal_entries(fleet)
+        terminal = [
+            e for e in entries
+            if e.get("job") == p_jid
+            and "event" not in e and e.get("state") in TS
+        ]
+        assert len(terminal) == 1, terminal
+        assert terminal[0]["state"] == "quarantined"
+        reassigned = [
+            e for e in entries
+            if e.get("event") == "job_reassigned"
+            and e.get("job") == p_jid
+        ]
+        assert len(reassigned) <= 1, reassigned
+        # tenant B's concurrent job finished (reassigned if its
+        # replica died mid-run — resume semantics hold)
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            code, body = req_auth(
+                port, "GET", f"/v1/jobs/{b_jid}", key="kb"
+            )
+            assert code == 200, body
+            bdoc = json.loads(body)
+            if bdoc["state"] in TS:
+                break
+            time.sleep(0.2)
+        assert bdoc["state"] == "finished", bdoc
+        # the shared breaker never opened: a control job (same
+        # input as B's) is accepted and completes...
+        code, body = req_auth(
+            port, "POST", "/v1/jobs", normal, key="kb"
+        )
+        assert code == 202, body  # 503 here = breaker poisoned
+        c_jid = json.loads(body)["id"]
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            code, body = req_auth(
+                port, "GET", f"/v1/jobs/{c_jid}", key="kb"
+            )
+            cdoc = json.loads(body)
+            if cdoc["state"] in TS:
+                break
+            time.sleep(0.2)
+        assert cdoc["state"] == "finished", cdoc
+        # ...and B's failover-era artifacts are byte-identical to
+        # the undisturbed control's
+        b_dir = os.path.join(fleet, "jobs", b_jid)
+        c_dir = os.path.join(fleet, "jobs", c_jid)
+        names = sorted(
+            f for f in os.listdir(c_dir) if f.endswith(".box")
+        )
+        assert names == sorted(
+            f for f in os.listdir(b_dir) if f.endswith(".box")
+        )
+        assert names, "control produced no artifacts"
+        for name in names:
+            with open(os.path.join(b_dir, name), "rb") as fa, open(
+                os.path.join(c_dir, name), "rb"
+            ) as fb:
+                assert fa.read() == fb.read(), (
+                    f"artifact {name} differs for the bystander "
+                    "tenant"
+                )
     finally:
         _kill_all(procs)
 

@@ -19,6 +19,7 @@ import urllib.request
 import pytest
 
 from repic_tpu.runtime import faults
+from repic_tpu.runtime.journal import _read_entries
 from repic_tpu.serve.daemon import ConsensusDaemon
 from repic_tpu.serve.jobs import (
     JOB_FINISHED,
@@ -38,7 +39,10 @@ SUBMIT = {
     "box_size": 180,
     "options": {"use_mesh": False},
 }
-TERMINAL = ("finished", "failed", "cancelled", "deadline_exceeded")
+TERMINAL = (
+    "finished", "failed", "cancelled", "deadline_exceeded",
+    "quarantined",
+)
 
 
 def _req(port, method, path, body=None, timeout=30):
@@ -507,7 +511,7 @@ def test_breaker_opens_after_repeated_failures(tmp_path):
 # -- crash recovery (subprocess: server_crash is os._exit) ------------
 
 
-def _spawn_daemon(wd, env_extra=None):
+def _spawn_daemon(wd, env_extra=None, extra_args=()):
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
@@ -520,7 +524,7 @@ def _spawn_daemon(wd, env_extra=None):
         env["REPIC_TPU_FAULTS"] = env_extra["REPIC_TPU_FAULTS"]
     proc = subprocess.Popen(
         [sys.executable, "-m", "repic_tpu.main", "serve", wd,
-         "--port", "0", "--no-warmup"],
+         "--port", "0", "--no-warmup", *extra_args],
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
         text=True,
@@ -584,6 +588,285 @@ def test_server_crash_recovers_all_accepted_jobs(tmp_path):
         # the resumed job really resumed: generation 2 only
         # processed what generation 1 had not journaled as done
         assert d1["result"]["resumed_micrographs"] >= 1, d1
+    finally:
+        proc2.terminate()
+        try:
+            proc2.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc2.kill()
+        proc2.communicate()
+
+
+# -- journal compaction (ISSUE 14) ------------------------------------
+
+
+def test_journal_compaction_folds_old_terminal_jobs(tmp_path):
+    """Old terminal jobs fold to one record each; every record of
+    every open job survives verbatim (resumed/cancel flags
+    included); idempotency keys ride the folded record; a second
+    compaction is a no-op."""
+    j = ServeJournal(str(tmp_path))
+    for i in range(6):
+        jid = f"t{i}"
+        j.record(jid, "queued", request={"n": i},
+                 idempotency_key=f"key-{i}", tenant="teamA")
+        j.record(jid, "running")
+        j.record(jid, "finished", particles=i)
+    j.record("open-q", "queued", request={"n": "q"})
+    j.record("open-r", "queued", request={"n": "r"})
+    j.record("open-r", "running")
+    j.record("open-r", "running", cancel_requested=True)
+    j.record_event("warmup", programs_warmed=1)
+    j.close()
+    with open(j.path, "a") as f:
+        f.write('{"job": "torn", "state": "que')  # crash tail
+    stats = ServeJournal(str(tmp_path)).compact(max_terminal=2)
+    assert stats["folded"] == 4  # 6 terminal - newest 2
+    entries = _read_entries(j.path)
+    # folded jobs: exactly one record, terminal, key+tenant carried,
+    # request payload dropped
+    for i in range(4):
+        recs = [e for e in entries if e.get("job") == f"t{i}"]
+        assert len(recs) == 1, recs
+        assert recs[0]["state"] == "finished"
+        assert recs[0]["folded"] is True
+        assert recs[0]["idempotency_key"] == f"key-{i}"
+        assert recs[0]["tenant"] == "teamA"
+        assert "request" not in recs[0]
+    # the newest 2 terminal jobs keep their full history
+    for i in (4, 5):
+        recs = [e for e in entries if e.get("job") == f"t{i}"]
+        assert len(recs) == 3, recs
+        assert recs[0]["request"] == {"n": i}
+    # recovery semantics are untouched: same open jobs, same flags
+    rec = {r.id: r for r in ServeJournal(str(tmp_path)).recover()}
+    assert set(rec) == {"open-q", "open-r"}
+    assert rec["open-r"].resumed is True
+    assert rec["open-r"].cancel_requested is True
+    assert rec["open-q"].request == {"n": "q"}
+    # idempotent: nothing left to fold
+    assert (
+        ServeJournal(str(tmp_path)).compact(max_terminal=2)
+        is None
+    )
+
+
+def test_compaction_runs_on_daemon_start(tmp_path, monkeypatch):
+    """A restarted daemon starts against a bounded journal: the
+    folded terminal jobs stay terminal (never re-queued) and the
+    queued job still runs."""
+    wd = str(tmp_path / "wd")
+    j = ServeJournal(wd)
+    for i in range(5):
+        j.record(f"t{i}", "queued", request={"n": i})
+        j.record(f"t{i}", "finished")
+    j.close()
+    monkeypatch.setattr(JobQueue, "MAX_TERMINAL", 2)
+    d = ConsensusDaemon(wd, port=0, warmup=False)
+    d.start()
+    try:
+        entries = _read_serve_journal(d)
+        assert any(
+            e.get("event") == "journal_compacted" for e in entries
+        )
+        folded = [
+            e for e in entries
+            if e.get("folded") is True and e.get("job")
+        ]
+        assert len(folded) == 3
+        # nothing resurrected
+        assert all(
+            j.state in TERMINAL
+            for j in d.queue.jobs()
+            if j.id.startswith("t")
+        )
+    finally:
+        d.drain()
+
+
+def test_compaction_folds_peer_terminal_jobs_via_hint(tmp_path):
+    """Fleet review fix: a job accepted here but finished on a PEER
+    has no local terminal record — the merged-view terminal hint
+    still folds it (last local record kept, ts intact, so the
+    peer's terminal record keeps winning the merged fold)."""
+    j = ServeJournal(str(tmp_path), replica="a")
+    for i in range(4):
+        j.record(f"p{i}", "queued", request={"n": i},
+                 idempotency_key=f"k{i}")
+    j.record("open", "queued", request={"n": "o"})
+    j.close()
+    stats = ServeJournal(str(tmp_path), replica="a").compact(
+        max_terminal=1, terminal_ids={f"p{i}" for i in range(4)}
+    )
+    assert stats["folded"] == 3  # 4 hinted-terminal - newest 1
+    entries = _read_entries(j.path)
+    for i in range(3):
+        recs = [e for e in entries if e.get("job") == f"p{i}"]
+        assert len(recs) == 1 and recs[0]["folded"] is True
+        assert recs[0]["state"] == "queued"  # last LOCAL record
+        assert recs[0]["idempotency_key"] == f"k{i}"
+        assert "request" not in recs[0]
+    # the open (un-hinted) job is untouched
+    assert any(
+        e.get("job") == "open" and "request" in e
+        for e in entries
+    )
+
+
+def test_rerun_records_do_not_bill_the_retry_budget(tmp_path):
+    """Review fix: the batcher's coalesce-fallback demotion journals
+    a same-process `rerun` running record — it is not a crashed
+    generation and must not consume the quarantine budget."""
+    j = ServeJournal(str(tmp_path))
+    j.record("jx", "queued", request={})
+    j.record("jx", "running")
+    for _ in range(3):
+        j.record("jx", "running", rerun=True)
+    j.close()
+    (job,) = ServeJournal(str(tmp_path)).recover()
+    assert job.attempts == 1
+    # and the queue's mark_running emits the flag on a demotion
+    q = JobQueue(4, ServeJournal(str(tmp_path / "q")))
+    job2 = q.submit({"r": 1})
+    assert q.next_job(0.01).id == job2.id
+    q.mark_running(job2)
+    q.mark_running(job2)  # same-process re-run (fallback shape)
+    runs = [
+        e
+        for e in _read_entries(q.journal.path)
+        if e.get("state") == "running"
+    ]
+    assert len(runs) == 2
+    assert not runs[0].get("rerun")
+    assert runs[1].get("rerun") is True
+
+
+# -- single-replica poison-job quarantine (ISSUE 14) ------------------
+
+
+def test_recover_quarantines_job_over_retry_budget(tmp_path):
+    """The single-replica half of the retry budget: a journaled
+    in-flight job that already crashed `budget + 1` generations is
+    quarantined at startup — terminal, exactly one terminal record,
+    visible over the API — and the daemon serves other jobs."""
+    from repic_tpu.serve.jobs import JOB_QUARANTINED
+
+    wd = str(tmp_path / "wd")
+    j = ServeJournal(wd)
+    j.record("poison", "queued", request=dict(SUBMIT),
+             trace="t-poison")
+    for _ in range(3):  # three crashed generations
+        j.record("poison", "running")
+    j.close()
+    d = ConsensusDaemon(wd, port=0, warmup=False,
+                        reassign_budget=2)
+    d.start()
+    try:
+        port = d.server.port
+        code, _, body = _req(port, "GET", "/v1/jobs/poison")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["state"] == JOB_QUARANTINED, doc
+        assert "retry budget" in doc["reason"]
+        assert doc["attempts"] == 3
+        states = [
+            e["state"]
+            for e in _read_serve_journal(d)
+            if e.get("job") == "poison" and "event" not in e
+        ]
+        assert states.count(JOB_QUARANTINED) == 1
+        assert states[-1] == JOB_QUARANTINED
+        # the daemon is healthy: a fresh job runs to completion
+        code, _, body = _req(port, "POST", "/v1/jobs", SUBMIT)
+        assert code == 202, body
+        doc2 = _wait_terminal(port, json.loads(body)["id"])
+        assert doc2["state"] == "finished", doc2
+    finally:
+        d.drain()
+
+
+def test_recover_requeues_job_within_budget(tmp_path):
+    """One crashed generation is WITHIN the default budget: the job
+    re-runs with resume semantics, exactly as before ISSUE 14."""
+    wd = str(tmp_path / "wd")
+    j = ServeJournal(wd)
+    j.record("ok-job", "queued", request=dict(SUBMIT))
+    j.record("ok-job", "running")
+    j.close()
+    d = ConsensusDaemon(wd, port=0, warmup=False)
+    d.start()
+    try:
+        doc = _wait_terminal(d.server.port, "ok-job")
+        assert doc["state"] == "finished", doc
+        assert doc["resumed"] is True
+    finally:
+        d.drain()
+
+
+@pytest.mark.faults
+def test_poison_job_fault_exits_26_and_quarantines_on_restart(
+    tmp_path,
+):
+    """End-to-end over real processes: the ``poison_job`` fault
+    kills the daemon (exit 26) on the first attempt; the restarted
+    daemon — same fault plan still armed, budget 0 — quarantines
+    the job at recovery instead of crashing again, and stays up."""
+    from repic_tpu.serve.jobs import (
+        JOB_QUARANTINED,
+        POISON_CRASH_EXIT_CODE,
+    )
+
+    wd = str(tmp_path / "wd")
+    os.makedirs(wd)
+    plan = "poison_job:mini10017:inf"
+    proc, port = _spawn_daemon(
+        wd,
+        {"REPIC_TPU_FAULTS": plan},
+        extra_args=["--reassign-budget", "0"],
+    )
+    try:
+        jid = None
+        try:
+            code, _, resp = _req(port, "POST", "/v1/jobs", SUBMIT)
+            assert code == 202, resp
+            jid = json.loads(resp)["id"]
+        except (
+            http.client.HTTPException, ConnectionError, OSError
+        ):
+            # the pill can kill the daemon while the 202 is still
+            # in flight — the torn-202 window journal-before-202
+            # exists for: the accept record is already durable
+            pass
+        assert proc.wait(timeout=120) == POISON_CRASH_EXIT_CODE
+        if jid is None:
+            queued = [
+                e["job"]
+                for e in _read_entries(
+                    os.path.join(wd, "_serve_journal.jsonl")
+                )
+                if e.get("state") == "queued"
+            ]
+            assert len(queued) == 1, queued
+            jid = queued[0]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    proc2, port2 = _spawn_daemon(
+        wd,
+        {"REPIC_TPU_FAULTS": plan},
+        extra_args=["--reassign-budget", "0"],
+    )
+    try:
+        doc = _wait_terminal(port2, jid, timeout=60)
+        assert doc["state"] == JOB_QUARANTINED, doc
+        assert doc["attempts"] == 1
+        # the poison is contained: the daemon still serves — the
+        # same INPUT in a fresh job would re-fire the plan, so
+        # prove liveness via the health and job surfaces instead
+        assert _req(port2, "GET", "/healthz/live")[0] == 200
+        code, _, body = _req(port2, "GET", "/v1/jobs")
+        assert code == 200
     finally:
         proc2.terminate()
         try:

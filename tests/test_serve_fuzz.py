@@ -247,6 +247,164 @@ def test_validate_submission_generative_sweep():
         )
 
 
+def test_tenants_file_parser_fuzz():
+    """ISSUE 14: the tenants keyfile is operator-supplied untrusted
+    input — the parser must be 'ValueError or a valid spec list,
+    never any other exception' over adversarial documents."""
+    from repic_tpu.serve.tenancy import TenantSpec, parse_tenants
+
+    rng = random.Random(20260804)
+    values = _weird_values(rng)
+    fields = (
+        "name", "keys", "rate", "burst", "max_open_jobs",
+        "max_queued_micrographs", "nope",
+    )
+    base = {"name": "teamA", "keys": ["ka"]}
+
+    def check(doc):
+        try:
+            specs = parse_tenants(doc)
+        except ValueError:
+            return
+        assert isinstance(specs, list)
+        assert all(isinstance(s, TenantSpec) for s in specs)
+
+    for field, v in itertools.product(fields, values):
+        entry = dict(base)
+        entry[field] = v
+        check({"tenants": [entry]})
+    # whole-document corruption
+    for v in values:
+        check(v)
+        check({"tenants": v})
+    # random multi-tenant documents
+    for _ in range(200):
+        n = rng.randint(0, 4)
+        doc = {
+            "tenants": [
+                {
+                    rng.choice(fields): rng.choice(values),
+                    "name": rng.choice(
+                        ["teamA", "teamA", "x y", "", 7]
+                    ),
+                    "keys": rng.choice(
+                        [["k"], ["k", "k"], [], "k", [1]]
+                    ),
+                }
+                for _ in range(n)
+            ]
+        }
+        check(doc)
+
+
+def test_authorization_header_fuzz():
+    """resolve() over arbitrary header strings: AuthError (401/403)
+    or a tenant name, never a crash — the serve worker must outlive
+    any credential a client can type."""
+    from repic_tpu.serve.tenancy import (
+        AuthError,
+        TenantRegistry,
+        TenantSpec,
+    )
+
+    reg = TenantRegistry(
+        [
+            TenantSpec(name="teamA", keys=("ka",)),
+            TenantSpec(name="anonymous"),
+        ]
+    )
+    rng = random.Random(4321)
+    headers = [
+        None, "", " ", "Bearer", "Bearer ", "Bearer ka",
+        "bearer ka", "BEARER ka", "Basic a2E=", "Bearer ka extra",
+        "Bearer\tka", "Bearer \x00", "Bearer " + "k" * 10_000,
+        "‮", "Bearer ‮", "ka", ": Bearer ka",
+    ] + [
+        "".join(
+            rng.choice(string.printable) for _ in range(
+                rng.randint(0, 40)
+            )
+        )
+        for _ in range(300)
+    ]
+    names = set()
+    for h in headers:
+        try:
+            name = reg.resolve(h)
+        except AuthError as e:
+            assert e.http_status in (401, 403), h
+            continue
+        names.add(name)
+        assert name in ("teamA", "anonymous"), (h, name)
+    assert "teamA" in names  # the real key did resolve
+
+
+def test_http_auth_fuzz_worker_survives(tmp_path):
+    """Garbage Authorization headers over real HTTP: every answer
+    is 401/403 (never 5xx), and a correctly-keyed job still runs."""
+    import time as _time
+    import urllib.error
+    import urllib.request
+
+    from repic_tpu.serve.daemon import ConsensusDaemon
+    from repic_tpu.serve.tenancy import TenantRegistry, TenantSpec
+
+    d = ConsensusDaemon(
+        str(tmp_path / "wd"),
+        port=0,
+        warmup=False,
+        queue_limit=4,
+        tenants=TenantRegistry(
+            [TenantSpec(name="teamA", keys=("ka",))]
+        ),
+    )
+    d.start()
+    try:
+        port = d.server.port
+        sub = json.dumps(
+            {"in_dir": FIXTURE, "box_size": 180,
+             "options": {"use_mesh": False}}
+        ).encode()
+
+        def post(auth):
+            headers = (
+                {} if auth is None else {"Authorization": auth}
+            )
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/jobs",
+                method="POST", data=sub, headers=headers,
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return r.status, r.read().decode()
+            except urllib.error.HTTPError as e:
+                return e.code, e.read().decode()
+
+        for auth in (
+            None, "", "Bearer", "Bearer nope", "Basic xx",
+            "Bearer " + "k" * 5000, "Bearer \x7f\x01",
+        ):
+            code, body = post(auth)
+            assert code in (401, 403), (auth, code, body)
+        code, body = post("Bearer ka")
+        assert code == 202, body
+        jid = json.loads(body)["id"]
+        deadline = _time.time() + 120
+        while _time.time() < deadline:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/jobs/{jid}",
+                headers={"Authorization": "Bearer ka"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                doc = json.loads(r.read().decode())
+            if doc["state"] not in ("queued", "running"):
+                break
+            _time.sleep(0.05)
+        assert doc["state"] == "finished", doc
+    finally:
+        d.drain()
+
+
 def test_http_maps_validation_to_400_and_413(tmp_path):
     """Round-trip a malicious selection over real HTTP: the daemon
     answers 400 (or 413 for an oversized body) and the worker stays
