@@ -135,6 +135,50 @@ def worker_dist(out_path, reps):
         json.dump({"steady_s": best, "pid": pid}, f)
 
 
+def worker_gang1(out_path, reps):
+    """Gang-of-one supervised dispatch: the same workload as
+    ``worker_single`` but every execution runs through
+    ``GangSupervisor.dispatch`` (worker thread + watchdog polling +
+    liveness machinery armed).  The single/gang delta IS the
+    supervision overhead the pod-scale path pays per chunk — the
+    CPU-backend-measurable half of "gang vs single-process"
+    (cross-process scaling needs a backend the capability probe
+    accepts)."""
+    _pin_from_env()
+    jax = _cpu_backend_single_device()
+    import tempfile
+
+    from repic_tpu.parallel.gang import GangConfig, GangSupervisor
+    from repic_tpu.pipeline.consensus import make_batched_consensus
+
+    xy, conf, mask = _workload_arrays()
+    fn = make_batched_consensus(max_neighbors=8, clique_capacity=4096)
+    sup = GangSupervisor(
+        GangConfig(
+            # deadlines far above any rep: the bench measures the
+            # supervision machinery, never a watchdog firing
+            watchdog_floor_s=900.0,
+            first_deadline_s=900.0,
+        ),
+        tempfile.mkdtemp(prefix="bench_gang_"),
+    )
+    sup.epoch = 1
+    sup.mode = "gang"
+    sup.host = "bench0"
+
+    def run():
+        sup.dispatch(
+            lambda: jax.block_until_ready(
+                fn(xy, conf, mask, WORKLOAD["box"]).picked
+            ),
+            key="bench",
+        )
+
+    best = _timed_reps(run, reps)
+    with open(out_path, "wt") as f:
+        json.dump({"steady_s": best}, f)
+
+
 def _spawn(argv, extra_env, repo_root):
     env = dict(os.environ)
     env.update(extra_env)
@@ -159,7 +203,15 @@ def main():
         "timeout should exceed 2x this plus startup slack)",
     )
     ap.add_argument("--out", help="append the JSON line to this file")
-    ap.add_argument("--worker", choices=["single", "dist"])
+    ap.add_argument(
+        "--gang",
+        action="store_true",
+        help="measure the gang-of-one supervised dispatch against "
+        "the plain single-process run (the supervision-overhead "
+        "row; runs on any backend — no cross-process SPMD needed) "
+        "instead of the two-process distributed comparison",
+    )
+    ap.add_argument("--worker", choices=["single", "dist", "gang1"])
     ap.add_argument("--worker_out")
     args = ap.parse_args()
 
@@ -167,6 +219,8 @@ def main():
         return worker_single(args.worker_out, args.reps)
     if args.worker == "dist":
         return worker_dist(args.worker_out, args.reps)
+    if args.worker == "gang1":
+        return worker_gang1(args.worker_out, args.reps)
 
     from bench import hold_chip_lock
 
@@ -193,6 +247,46 @@ def main():
     out, _ = p.communicate(timeout=args.timeout)
     assert p.returncode == 0, f"single worker failed:\n{out[-2000:]}"
     single_s = json.load(open(single_out))["steady_s"]
+
+    if args.gang:
+        # Gang-supervision overhead row (advisory CI trend via
+        # scripts/bench_compare.py --history): same workload, same
+        # machine, dispatch wrapped by the gang watchdog.  `value`
+        # is gang-path throughput so bench_compare/BENCH_HISTORY
+        # track it like every other headline.
+        gang_out = os.path.join(tmp, "gang1.json")
+        p = _spawn(
+            ["--worker", "gang1", "--worker_out", gang_out,
+             "--reps", str(args.reps)],
+            env, repo_root,
+        )
+        out, _ = p.communicate(timeout=args.timeout)
+        assert p.returncode == 0, (
+            f"gang worker failed:\n{out[-2000:]}"
+        )
+        gang_s = json.load(open(gang_out))["steady_s"]
+        line = json.dumps(
+            {
+                "metric": (
+                    "gang-supervised consensus vs single-process "
+                    "(CPU backend, gang of one)"
+                ),
+                "workload": WORKLOAD,
+                "n_cores": n_cores,
+                "single_proc_s": round(single_s, 3),
+                "gang_proc_s": round(gang_s, 3),
+                "supervision_overhead_pct": round(
+                    (gang_s / single_s - 1.0) * 100.0, 2
+                ),
+                "value": round(WORKLOAD["m"] / gang_s, 3),
+                "warm_total_s": round(gang_s, 3),
+            }
+        )
+        print(line, flush=True)
+        if args.out:
+            with open(args.out, "at") as f:
+                f.write(line + "\n")
+        return
 
     # Two-process measurement: disjoint cores when available.
     port = _free_port()

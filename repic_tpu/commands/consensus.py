@@ -161,6 +161,71 @@ def add_arguments(parser):
         "itself to keep coordination files next to the journals",
     )
     parser.add_argument(
+        "--gang",
+        action="store_true",
+        help="gang-schedule the SPMD path across processes: every "
+        "chunk runs as ONE jax.distributed program sharded over the "
+        "multi-host mesh (identity from JAX_COORDINATOR_ADDRESS/"
+        "JAX_NUM_PROCESSES/JAX_PROCESS_ID; a single process forms a "
+        "gang of one).  Every dispatch runs under a collective "
+        "watchdog; a peer lost mid-collective aborts the wedged "
+        "program, and survivors re-form a smaller gang over the "
+        "remaining work or degrade to independent per-host "
+        "execution (docs/robustness.md 'Pod-scale gangs').  Implies "
+        "cluster semantics over --coordination-dir (default: "
+        "out_dir)",
+    )
+    parser.add_argument(
+        "--gang-min-world",
+        type=int,
+        default=None,
+        metavar="N",
+        help="below this surviving world size re-formation gives up "
+        "and survivors degrade to independent execution (default 1; "
+        "requires --gang)",
+    )
+    parser.add_argument(
+        "--gang-watchdog-factor",
+        type=float,
+        default=None,
+        metavar="F",
+        help="collective watchdog deadline = max(floor, F x decayed "
+        "per-chunk service time) (default 4.0; requires --gang)",
+    )
+    parser.add_argument(
+        "--gang-watchdog-floor",
+        type=float,
+        default=None,
+        metavar="S",
+        help="minimum watchdog deadline in seconds (default 10.0; "
+        "requires --gang)",
+    )
+    parser.add_argument(
+        "--gang-first-deadline",
+        type=float,
+        default=None,
+        metavar="S",
+        help="watchdog deadline for dispatches with no service-time "
+        "estimate yet or a fresh compile ahead of them (default "
+        "600.0 — compile dwarfs execution; requires --gang)",
+    )
+    parser.add_argument(
+        "--gang-reform-timeout",
+        type=float,
+        default=None,
+        metavar="S",
+        help="seconds a survivor waits for the new epoch record / "
+        "re-initialization during gang re-formation (default 60.0; "
+        "requires --gang)",
+    )
+    parser.add_argument(
+        "--gang-no-degrade",
+        action="store_true",
+        help="fail the run when gang re-formation fails instead of "
+        "degrading to independent per-host execution (requires "
+        "--gang)",
+    )
+    parser.add_argument(
         "--heartbeat-interval",
         type=float,
         default=None,
@@ -193,6 +258,40 @@ def main(args):
             "repic-tpu consensus: error: --solver_budget requires "
             "--solver exact (the device greedy/lp packers take no "
             "budget)"
+        )
+    gang_flags = (
+        ("--gang-min-world", args.gang_min_world),
+        ("--gang-watchdog-factor", args.gang_watchdog_factor),
+        ("--gang-watchdog-floor", args.gang_watchdog_floor),
+        ("--gang-first-deadline", args.gang_first_deadline),
+        ("--gang-reform-timeout", args.gang_reform_timeout),
+        ("--gang-no-degrade", args.gang_no_degrade or None),
+    )
+    gang = None
+    if args.gang:
+        from repic_tpu.parallel.gang import GangConfig
+
+        kwargs = {}
+        if args.gang_min_world is not None:
+            kwargs["min_world"] = args.gang_min_world
+        if args.gang_watchdog_factor is not None:
+            kwargs["watchdog_factor"] = args.gang_watchdog_factor
+        if args.gang_watchdog_floor is not None:
+            kwargs["watchdog_floor_s"] = args.gang_watchdog_floor
+        if args.gang_first_deadline is not None:
+            kwargs["first_deadline_s"] = args.gang_first_deadline
+        if args.gang_reform_timeout is not None:
+            kwargs["reform_timeout_s"] = args.gang_reform_timeout
+        if args.gang_no_degrade:
+            kwargs["allow_degrade"] = False
+        gang = GangConfig(**kwargs)
+    elif any(v is not None for _f, v in gang_flags):
+        raise SystemExit(
+            "repic-tpu consensus: error: --gang-min-world/"
+            "--gang-watchdog-factor/--gang-watchdog-floor/"
+            "--gang-first-deadline/--gang-reform-timeout/"
+            "--gang-no-degrade require --gang (gang-scheduled "
+            "SPMD execution)"
         )
     cluster = None
     if args.coordination_dir:
@@ -247,6 +346,7 @@ def main(args):
                 retry_policy=policy,
                 solver_budget_s=args.solver_budget,
                 cluster=cluster,
+                gang=gang,
             )
     print(json.dumps(stats, default=str, indent=2))
 

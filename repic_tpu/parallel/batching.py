@@ -65,16 +65,33 @@ def pad_batch(
     *,
     pad_micrographs_to: int = 1,
     capacity: int | None = None,
+    num_pickers: int | None = None,
 ) -> PaddedBatch:
     """Pack per-micrograph, per-picker ragged BoxSets into one batch.
 
     Args:
-        micrographs: list of (name, [BoxSet per picker]).
+        micrographs: list of (name, [BoxSet per picker]).  May be
+            EMPTY when ``num_pickers`` and ``capacity`` are given:
+            the result is an all-padding batch of
+            ``pad_micrographs_to`` masked micrographs — how a gang
+            rank whose shard ran dry (``len(items) <
+            process_count``) pad-participates in the collective.
         pad_micrographs_to: round M up to a multiple of this (the mesh
             data-axis size), adding all-masked padding micrographs.
         capacity: static N; default = bucket_size(max particle count).
+        num_pickers: static K, required for an empty ``micrographs``
+            list (there is no row to infer it from).
     """
-    k = len(micrographs[0][1])
+    if not micrographs:
+        if num_pickers is None or capacity is None:
+            raise ValueError(
+                "pad_batch([]) needs explicit num_pickers and "
+                "capacity (an empty shard has no row to infer "
+                "the batch shape from)"
+            )
+        k = num_pickers
+    else:
+        k = len(micrographs[0][1])
     max_n = max(
         (bs.n for _, sets in micrographs for bs in sets), default=1
     )
@@ -82,7 +99,12 @@ def pad_batch(
     if n < max_n:
         raise ValueError(f"capacity {n} < max particle count {max_n}")
     m_real = len(micrographs)
-    m = -(-m_real // pad_micrographs_to) * pad_micrographs_to
+    # an empty shard still pads to one full round of the data axis
+    # (zero rows cannot participate in a sharded collective)
+    m = max(
+        -(-m_real // pad_micrographs_to) * pad_micrographs_to,
+        pad_micrographs_to,
+    )
 
     xy = np.zeros((m, k, n, 2), np.float32)
     conf = np.zeros((m, k, n), np.float32)

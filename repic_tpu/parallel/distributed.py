@@ -28,6 +28,23 @@ from __future__ import annotations
 import os
 
 
+def _env_int(name: str) -> int | None:
+    """Parse an integer launch variable, failing with a structured
+    one-line error naming the variable and the offending value — a
+    bare ``ValueError: invalid literal for int()`` from a pod
+    launcher's template bug costs a debugging session per host."""
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            "repic_tpu.parallel.distributed: invalid launch "
+            f"environment: {name}={raw!r} is not an integer"
+        ) from None
+
+
 def _publish_host_gauges() -> None:
     """Per-host identity gauges for the metrics registry.
 
@@ -102,13 +119,12 @@ def initialize(
             RuntimeWarning,
             stacklevel=2,
         )
-    env_np = os.environ.get("JAX_NUM_PROCESSES")
-    if num_processes is None and env_np:
-        num_processes = int(env_np)
+    if num_processes is None:
+        num_processes = _env_int("JAX_NUM_PROCESSES")
     if coordinator_address is None:
         coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
-    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
-        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if process_id is None:
+        process_id = _env_int("JAX_PROCESS_ID")
     if not coordinator_address and (num_processes or 1) <= 1:
         return False  # single process — nothing to do
     try:
@@ -156,16 +172,97 @@ def runtime_identity() -> "tuple[str, int, int] | None":
     try:
         from jax._src import distributed as _jax_distributed
 
-        if getattr(_jax_distributed.global_state, "client", None) is None:
-            return None
-        import jax
+        state_client = getattr(
+            _jax_distributed.global_state, "client", None
+        )
+    except (ImportError, AttributeError) as e:
+        # the documented private-module-drift case ONLY — and loudly,
+        # with the same structured RuntimeWarning the initialize()
+        # fallbacks emit: a silent None here makes a misconfigured
+        # pod launch masquerade as a single-host run (host ids fall
+        # back to env/defaults and every peer calls itself host0)
+        import warnings
 
-        rank = int(jax.process_index())
-        return (f"proc{rank}", rank, int(jax.process_count()))
-    except Exception:
-        # private-module drift or a backend that refuses process
-        # queries: identity falls back to env vars / single-host
+        warnings.warn(
+            "repic_tpu.parallel.distributed: "
+            "fallback=no-runtime-identity "
+            "reason=jax-private-distributed-state-unavailable "
+            f"({type(e).__name__}: {e})",
+            RuntimeWarning,
+            stacklevel=2,
+        )
         return None
+    if state_client is None:
+        return None
+    import jax
+
+    rank = int(jax.process_index())
+    return (f"proc{rank}", rank, int(jax.process_count()))
+
+
+def shutdown() -> bool:
+    """Tear down an active ``jax.distributed`` client (idempotent).
+
+    The gang re-formation path (:mod:`repic_tpu.parallel.gang`) calls
+    this after a collective fault: survivors must leave the wedged
+    runtime before re-initializing at the new world size.  Returns
+    True when a client was actually shut down.  Best-effort on the
+    cache side — a failed cache clear degrades re-formation (the
+    supervisor then falls back to independent execution), it must
+    not mask the shutdown itself.
+    """
+    import jax
+
+    try:
+        from jax._src import distributed as _jax_distributed
+
+        if getattr(_jax_distributed.global_state, "client", None) is None:
+            return False
+    except (ImportError, AttributeError):
+        pass  # cannot inspect: attempt the public shutdown anyway
+    try:
+        jax.distributed.shutdown()
+    except RuntimeError:
+        return False  # already down
+    try:
+        jax.clear_caches()
+    except Exception:  # pragma: no cover - cache API drift
+        import warnings
+
+        warnings.warn(
+            "repic_tpu.parallel.distributed: "
+            "fallback=stale-executable-caches "
+            "reason=jax.clear_caches-failed",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+    # Backend reset: a later re-initialize (gang re-formation at a
+    # smaller world size) refuses to run over live XLA backends, and
+    # a degraded survivor must not keep dispatching onto a device
+    # list that still names the dead world.  clear_backends is the
+    # supported spelling; the private one covers older layouts.
+    try:
+        from jax.extend import backend as _jax_backend
+
+        _jax_backend.clear_backends()
+    except Exception:
+        try:
+            from jax._src import api as _jax_api
+
+            _jax_api.clear_backends()
+        except Exception:  # pragma: no cover - backend API drift
+            import warnings
+
+            warnings.warn(
+                "repic_tpu.parallel.distributed: "
+                "fallback=stale-backend-devices "
+                "reason=clear_backends-unavailable (a gang "
+                "re-initialize at a new world size may refuse "
+                "to run)",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+    return True
 
 
 def shard_for_process(items, process_id=None, process_count=None):
@@ -183,13 +280,36 @@ def shard_for_process(items, process_id=None, process_count=None):
     return items[pid * per : (pid + 1) * per]
 
 
-def assemble_global_batch(mesh, local_arrays, pspec=None):
+def local_row_quota(shard_len: int, local_devices: int) -> int:
+    """Per-process padded row count for a gang chunk: the local shard
+    length rounded up to the local device count, floored at one full
+    device row — an EMPTY shard (``len(items) < process_count`` hands
+    high ranks nothing) still participates in every collective with
+    all-padding rows instead of desyncing the SPMD program."""
+    return max(-(-shard_len // local_devices) * local_devices,
+               local_devices)
+
+
+def assemble_global_batch(
+    mesh, local_arrays, pspec=None, pad_rows_to: int | None = None
+):
     """Build global sharded arrays from per-process local data.
 
     ``local_arrays`` are this process's batch-leading numpy arrays
     (its ``shard_for_process`` share, padded identically on every
     host); returns global ``jax.Array`` views over the mesh.
+
+    ``pad_rows_to`` is the pad-participate contract for uneven (or
+    empty) shards: every local array whose leading dimension is
+    shorter is zero-padded to that many rows — zeros are all-masked
+    micrographs on every consensus input (``mask`` pads False), so a
+    rank whose shard ran dry still contributes identically-shaped
+    shards to the collective and simply emits nothing.  Without it a
+    zero-row local shard fails the global-shape check inside
+    ``jax.make_array_from_process_local_data``.
     """
+    import numpy as np
+
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -198,7 +318,17 @@ def assemble_global_batch(mesh, local_arrays, pspec=None):
     sharding = NamedSharding(
         mesh, pspec if pspec is not None else P(MICROGRAPH_AXIS)
     )
+
+    def _padded(a):
+        a = np.asarray(a)
+        if pad_rows_to is None or a.shape[0] >= pad_rows_to:
+            return a
+        pad = np.zeros(
+            (pad_rows_to - a.shape[0],) + a.shape[1:], a.dtype
+        )
+        return np.concatenate([a, pad], axis=0)
+
     return tuple(
-        jax.make_array_from_process_local_data(sharding, a)
+        jax.make_array_from_process_local_data(sharding, _padded(a))
         for a in local_arrays
     )

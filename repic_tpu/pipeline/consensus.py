@@ -359,6 +359,75 @@ def _make_batched_consensus(
     )
 
 
+@checked(Contract(
+    # The gang-mode SPMD chunk entry (docs/robustness.md "Pod-scale
+    # gangs"): one GLOBAL batch of M micrographs sharded over the
+    # multi-host mesh's micrograph axis.  pspecs declare the
+    # batch-axis sharding `repic-tpu check` RT102 validates against
+    # parallel/mesh.py — the axis every gang dispatch partitions on.
+    args={
+        "xy": spec("M K N 2"),
+        "conf": spec("M K N"),
+        "mask": spec("M K N", "bool"),
+        "box_size": spec(""),
+    },
+    returns={
+        "rep_xy": spec("M C 2"),
+        "confidence": spec("M C"),
+        "w": spec("M C"),
+        "member_idx": spec("M C K", "int32"),
+        "rep_slot": spec("M C", "int32"),
+        "picked": spec("M C", "bool"),
+        "valid": spec("M C", "bool"),
+        "num_cliques": spec("M", "int32"),
+        "max_adjacency": spec("M", "int32"),
+        "max_partial": spec("M", "int32"),
+    },
+    dims={"M": 8, "K": 3, "N": 8, "C": 64},
+    static={"clique_capacity": 64, "max_neighbors": 4},
+    pspecs={
+        "xy": (MICROGRAPH_AXIS,),
+        "conf": (MICROGRAPH_AXIS,),
+        "mask": (MICROGRAPH_AXIS,),
+    },
+    max_trace_variants=4,
+))
+def gang_consensus_chunk(
+    xy,
+    conf,
+    mask,
+    box_size,
+    *,
+    threshold: float = DEFAULT_THRESHOLD,
+    max_neighbors: int = 16,
+    clique_capacity: int = 4096,
+    mesh=None,
+    spatial_grid: int | None = None,
+    cell_capacity: int = 64,
+    solver: str = "greedy",
+    use_pallas: bool = False,
+    partial_capacity: int | None = None,
+) -> ConsensusResult:
+    """One gang chunk: the batched consensus program over a global
+    (multi-host) batch.  Thin named entry over
+    :func:`make_batched_consensus` so the pod-scale path has its own
+    trace-time contract; inputs are the ``assemble_global_batch``
+    views, outputs stay sharded (each host fetches only its
+    addressable shards)."""
+    fn = make_batched_consensus(
+        threshold=threshold,
+        max_neighbors=max_neighbors,
+        clique_capacity=clique_capacity,
+        mesh=mesh,
+        spatial_grid=spatial_grid,
+        cell_capacity=cell_capacity,
+        solver=solver,
+        use_pallas=use_pallas,
+        partial_capacity=partial_capacity,
+    )
+    return fn(xy, conf, mask, box_size)
+
+
 SPATIAL_THRESHOLD = 4096  # particle count above which the bucketed
 # (O(N * 9B)-memory) path replaces the dense O(N^2) kernel
 
@@ -650,6 +719,19 @@ def _next_bucket(x: int) -> int:
     # more potential configs per component for a tighter work fit —
     # escalation still jumps straight to the observed requirement)
     return bucket_size(int(x), minimum=2)
+
+
+@jax.jit
+def _gang_reduce_max(x):
+    """Replicated elementwise max over the gang axis — the tiny
+    collective that agrees static batch shapes across hosts."""
+    return jnp.max(x, axis=0)
+
+
+def _atomic_sink(out_dir, fname, content):
+    """Atomic per-file BOX sink shared by the gang emit path."""
+    with atomic_write(os.path.join(out_dir, fname)) as o:
+        o.write(content)
 
 
 @jax.jit
@@ -1389,6 +1471,7 @@ def run_consensus_dir(
     retry_policy: "RetryPolicy | None" = None,
     solver_budget_s: float | None = None,
     cluster: "ClusterConfig | None" = None,
+    gang: "GangConfig | None" = None,
 ) -> dict:
     """End-to-end: read picker BOX dirs, consensus, write BOX files.
 
@@ -1437,6 +1520,24 @@ def run_consensus_dir(
     (``out_dir`` is shared, so it is never deleted; a manifest
     mismatch raises instead of restarting) and composes with the
     batched path only (not ``stripes``).
+
+    Gang mode (``gang=GangConfig(...)``, docs/robustness.md
+    "Pod-scale gangs"): N processes execute every chunk as ONE
+    gang-scheduled SPMD program — the chunk's global batch is
+    sharded over the multi-host mesh via ``shard_for_process`` +
+    ``assemble_global_batch``, each host loads/emits/journals only
+    its own shard (the PR 6 per-host single-writer scheme), and
+    every dispatch runs under the collective watchdog of
+    :class:`repic_tpu.parallel.gang.GangSupervisor`.  A peer lost
+    mid-collective is a *gang fault*: survivors abort the wedged
+    program, re-form a smaller gang over the remaining todo, or
+    degrade to independent per-host execution — the transition is
+    journaled (``gang_formed`` / ``gang_fault`` / ``gang_reformed``
+    events, epoch-tagged so a fenced straggler's late writes lose).
+    Implies cluster semantics (heartbeats, fences, per-host
+    journals); composes with the plain-BOX batched path only (not
+    ``stripes`` / ``multi_out`` / ``get_cc`` / the host ``exact``
+    solver).
     """
     import shutil
 
@@ -1476,6 +1577,33 @@ def run_consensus_dir(
                 "dense XLA kernels",
                 stacklevel=2,
             )
+    gang_sup = None
+    if gang is not None:
+        if stripes is not None or multi_out or get_cc or host_solver:
+            raise ValueError(
+                "gang mode composes with the plain-BOX batched path "
+                "only (not --stripes/--multi_out/--get_cc/--solver "
+                "exact)"
+            )
+        from repic_tpu.parallel.gang import GangSupervisor
+
+        # The distributed runtime MUST come up before any XLA
+        # backend use below (jax.devices(), probes, compiles) — a
+        # late initialize refuses to run.  The supervisor binds to
+        # the journal/cluster context once the run directory exists.
+        gang_sup = GangSupervisor(
+            gang,
+            cluster.coordination_dir
+            if cluster is not None and cluster.coordination_dir
+            else out_dir,
+        )
+        gang_sup.form_runtime()
+        if cluster is None:
+            from repic_tpu.runtime.cluster import ClusterConfig
+
+            # gang implies cluster semantics: per-host journals,
+            # heartbeats (the watchdog's liveness input), fences
+            cluster = ClusterConfig(coordination_dir=out_dir)
     cluster_ctx = None
     if cluster is not None:
         if stripes is not None:
@@ -1598,7 +1726,15 @@ def run_consensus_dir(
                 out_name = latest[nm].get("out", nm + out_ext)
                 if os.path.exists(os.path.join(out_dir, out_name)):
                     already_done.add(nm)
-        if cluster_ctx is not None:
+        if gang_sup is not None:
+            # the gang owns the todo COLLECTIVELY (each chunk is one
+            # SPMD program over every host) — no per-host lease
+            # split; every member derives the same list from the
+            # merged journal view behind the formation barrier
+            gang_sup.bind(journal, cluster_ctx)
+            todo_names = [n for n in names if n not in already_done]
+            cluster_ctx.crash_point("start")
+        elif cluster_ctx is not None:
             # lease this host's deterministic shard of the FULL name
             # list (a done-filtered list would shift the partition
             # boundaries between staggered hosts); dead peers'
@@ -1638,6 +1774,15 @@ def run_consensus_dir(
 
         skipped, quarantined = [], {}
 
+        def _gang_fields():
+            """Epoch tag on every gang-mode journal record — the
+            write-fencing input of the merged-journal fold."""
+            return (
+                {"gang_epoch": gang_sup.epoch}
+                if gang_sup is not None
+                else {}
+            )
+
         def _partition_loaded(nms, all_sets):
             """Split load results into processable (name, sets) pairs,
             journaling quarantines and empty-input skips."""
@@ -1650,19 +1795,29 @@ def run_consensus_dir(
                     )
                     quarantined[name] = info
                     journal.record(
-                        name, "quarantined", error=info, stage="load"
+                        name, "quarantined", error=info, stage="load",
+                        **_gang_fields(),
                     )
                 elif sets is None:
                     skipped.append(name)
                     box_io.write_empty_box(
                         os.path.join(out_dir, name + ".box")
                     )
-                    journal.record(name, "skipped", out=name + ".box")
+                    journal.record(
+                        name, "skipped", out=name + ".box",
+                        **_gang_fields(),
+                    )
                 else:
                     out.append((name, sets))
             return out
 
-        loaded = _partition_loaded(todo_names, _load_many(todo_names))
+        # gang mode loads lazily per shard (each host parses only its
+        # 1/world of the inputs — the whole point of the gang axis)
+        loaded = (
+            []
+            if gang_sup is not None
+            else _partition_loaded(todo_names, _load_many(todo_names))
+        )
 
         stats = {
             "pickers": pickers,
@@ -1952,6 +2107,10 @@ def run_consensus_dir(
                     src = outcomes.reassigned.get(nm)
                     if src is not None:
                         fields["reassigned_from"] = src
+                    # a degraded gang's independent records still
+                    # carry the (bumped) epoch, outranking any
+                    # straggler from the broken gang
+                    fields.update(_gang_fields())
                     journal.record(
                         nm, outcomes.status.get(nm, "ok"), **fields
                     )
@@ -2029,13 +2188,439 @@ def run_consensus_dir(
                     )
                     cluster_ctx.ensure_not_fenced()
 
-        if loaded:
+        def _merged_remaining(pool):
+            """Names of ``pool`` not yet terminal in the merged
+            (all-hosts, epoch-aware) journal view."""
+            merged = cluster_ctx.merged_latest()
+            return [
+                n
+                for n in pool
+                if merged.get(n, {}).get("status")
+                not in DONE_STATUSES
+                and merged.get(n, {}).get("status")
+                != STATUS_QUARANTINED
+            ]
+
+        def _gang_exchange(sup, mesh, L, values):
+            """Elementwise global max of a small per-host vector —
+            the one tiny collective that agrees batch capacity and
+            spatial extent across the gang (static shapes must be
+            identical on every host or the SPMD programs diverge).
+            Runs under the watchdog like any dispatch."""
+            from repic_tpu.parallel import distributed as dist
+
+            arr = np.tile(
+                np.asarray(values, np.float32)[None, :], (L, 1)
+            )
+            (g,) = dist.assemble_global_batch(mesh, (arr,))
+            return sup.dispatch(
+                lambda: np.asarray(_gang_reduce_max(g)),
+                key="exchange",
+                fresh_compile=True,
+            )
+
+        def _gang_execute(sup, mesh, caps, grid, gxy, gconf, gmask,
+                          box_arg, rows, box_rank, ckey):
+            """One gang chunk with the shared escalation policy.
+
+            Capacities escalate identically on every host (the probe
+            vector is a replicated global reduction), so the gang
+            recompiles in lockstep.  Returns this host's packed
+            output rows — the only per-host transfer."""
+            d, cap, cell_cap, pcap = caps["v"]
+            # watchdog hint: signatures whose dispatch COMPLETED.
+            # The cache counters mark a signature at dispatch time,
+            # but an aborted (stalled/faulted) dispatch never
+            # compiled — its retry on the re-formed gang must get
+            # the first-call deadline, not the warm one.
+            executed = caps.setdefault("executed", set())
+            while True:
+                sig = program_signature(
+                    threshold, d, cap, True, grid, cell_cap, solver,
+                    use_pallas, pcap, gxy.shape,
+                )
+                fresh = sig not in _PROGRAM_SIGNATURES
+                if fresh:
+                    _PROGRAM_SIGNATURES.add(sig)
+                    _PROGRAM_MISSES.inc()
+                    _persist_program_signature(sig, box_rank=box_rank)
+                else:
+                    _PROGRAM_HITS.inc()
+
+                def _go():
+                    res = gang_consensus_chunk(
+                        gxy, gconf, gmask, box_arg,
+                        threshold=threshold,
+                        max_neighbors=d,
+                        clique_capacity=cap,
+                        mesh=mesh,
+                        spatial_grid=grid,
+                        cell_capacity=cell_cap,
+                        solver=solver,
+                        use_pallas=use_pallas,
+                        partial_capacity=pcap,
+                    )
+                    packed_g = _pack_box_outputs(
+                        res.picked, res.rep_xy, res.confidence,
+                        res.rep_slot, res.num_cliques,
+                        res.max_adjacency, res.max_cell_count,
+                        res.max_partial,
+                    )
+                    probes = np.asarray(
+                        _probe_reduce(
+                            res.max_adjacency, res.num_cliques,
+                            res.max_cell_count, res.max_partial,
+                        )
+                    )
+                    shards = sorted(
+                        packed_g.addressable_shards,
+                        key=lambda s: s.index[0].start or 0,
+                    )
+                    local = np.concatenate(
+                        [np.asarray(s.data) for s in shards]
+                    )
+                    if local.shape[0] != rows:
+                        raise RuntimeError(
+                            "gang output shard layout mismatch: "
+                            f"fetched {local.shape[0]} rows, "
+                            f"expected this host's {rows}"
+                        )
+                    telemetry.record_transfer(
+                        local.nbytes + probes.nbytes
+                    )
+                    return probes, local
+
+                probes, local_packed = sup.dispatch(
+                    _go, key=ckey,
+                    fresh_compile=sig not in executed,
+                )
+                executed.add(sig)
+                d, cap, cell_cap, pcap, retry = escalate_capacities(
+                    probes, d, cap, cell_cap, pcap,
+                    has_grid=grid is not None,
+                )
+                if not retry:
+                    caps["v"] = (d, cap, cell_cap, pcap)
+                    return local_packed
+                _ESCALATIONS.inc()
+                tlm_events.event(
+                    "capacity_escalated",
+                    max_neighbors=d, clique_capacity=cap,
+                    cell_capacity=cell_cap, partial_capacity=pcap,
+                )
+
+        def _process_gang(todo_all):
+            """Gang-scheduled SPMD over the global todo: every chunk
+            is ONE program over the multi-host mesh; this host loads,
+            emits, and journals only its ``shard_for_process``
+            share.  Gang faults re-form (or degrade) and the loop
+            resumes over the re-derived remainder."""
+            nonlocal compute_s, write_s, num_cliques, use_mesh, n_dev
+            from repic_tpu.parallel import distributed as dist
+            from repic_tpu.parallel.gang import GangFault, GangFenced
+            from repic_tpu.parallel.mesh import consensus_mesh
+            from repic_tpu.runtime.cluster import HostFenced
+
+            sup = gang_sup
+            L = jax.local_device_count()
+            k = len(pickers)
+            sizes = np.asarray(box_size, np.float32)
+            max_size = float(sizes.max())
+            box_arg = sizes if sizes.ndim else float(box_size)
+            loaded_by_name: dict = {}
+            caps: dict = {"v": None}
+            todo = list(todo_all)
+            chunk_global: int | None = None
+
+            while todo and sup.mode == "gang":
+                my_todo = dist.shard_for_process(
+                    todo, sup.rank, sup.world
+                )
+                fresh_names = [
+                    n
+                    for n in my_todo
+                    if n not in loaded_by_name
+                    and n not in quarantined
+                    and n not in skipped
+                ]
+                if fresh_names:
+                    for nm, sets in _partition_loaded(
+                        fresh_names, _load_many(fresh_names)
+                    ):
+                        loaded_by_name[nm] = sets
+                try:
+                    # fresh mesh per epoch: after a re-formation the
+                    # memoized default mesh spans a dead world
+                    mesh = consensus_mesh(jax.devices())
+                    n_dev_g = len(jax.devices())
+                    local_max_n = max(
+                        (
+                            bs.n
+                            for nm in my_todo
+                            if nm in loaded_by_name
+                            for bs in loaded_by_name[nm]
+                        ),
+                        default=0,
+                    )
+                    local_extent = max(
+                        (
+                            float(np.max(bs.xy)) if bs.n else 0.0
+                            for nm in my_todo
+                            if nm in loaded_by_name
+                            for bs in loaded_by_name[nm]
+                        ),
+                        default=0.0,
+                    )
+                    agreed = _gang_exchange(
+                        sup, mesh, L, (local_max_n, local_extent)
+                    )
+                    nb = bucket_size(max(int(agreed[0]), 1))
+                    spatial_flag = (
+                        spatial
+                        if spatial is not None
+                        else nb > SPATIAL_THRESHOLD
+                    )
+                    grid = None
+                    if spatial_flag:
+                        from repic_tpu.ops.spatial import grid_size
+
+                        grid = grid_size(
+                            float(agreed[1]) + max_size, max_size
+                        )
+                    if caps["v"] is None:
+                        cap0 = max(4 * nb, 1024)
+                        caps["v"] = (max_neighbors, cap0, 64, cap0)
+                    if chunk_global is None:
+                        chunk_global = _auto_chunk(
+                            len(todo), k, nb, n_dev_g
+                        )
+                    rows = dist.local_row_quota(
+                        -(-min(chunk_global, len(todo))
+                          // sup.world),
+                        L,
+                    )
+                    per = -(-len(todo) // sup.world)
+                    n_chunks = max(-(-per // rows), 1)
+                    for ci in range(n_chunks):
+                        part_names = my_todo[
+                            ci * rows: (ci + 1) * rows
+                        ]
+                        part = [
+                            (nm, loaded_by_name[nm])
+                            for nm in part_names
+                            if nm in loaded_by_name
+                        ]
+                        lbatch = pad_batch(
+                            part,
+                            pad_micrographs_to=rows,
+                            capacity=nb,
+                            num_pickers=k,
+                        )
+                        gxy, gconf, gmask = (
+                            dist.assemble_global_batch(
+                                mesh,
+                                (
+                                    lbatch.xy,
+                                    lbatch.conf,
+                                    lbatch.mask,
+                                ),
+                                pad_rows_to=rows,
+                            )
+                        )
+                        ckey = f"gchunk:{sup.epoch}:{ci}"
+                        t1 = time.time()
+                        with tlm_events.span(
+                            "gang_chunk",
+                            micrographs=len(part),
+                            epoch=sup.epoch,
+                            capacity=nb,
+                        ):
+                            faults.inject("oom", ckey)
+                            faults.inject("io", ckey)
+                            local_packed = _gang_execute(
+                                sup, mesh, caps, grid, gxy, gconf,
+                                gmask, box_arg, rows, sizes.ndim,
+                                ckey,
+                            )
+                        chunk_s = time.time() - t1
+                        compute_s += chunk_s
+                        _CHUNKS.inc()
+                        tlm_trace.add_segment(
+                            "execute", t1, chunk_s,
+                            chunk=len(parts), gang_epoch=sup.epoch,
+                            micrographs=len(part), capacity=nb,
+                        )
+                        parts.append(len(part))
+                        t2 = time.time()
+                        with tlm_events.span(
+                            "write", micrographs=len(part)
+                        ):
+                            chunk_counts = emit_box_chunk(
+                                lbatch, local_packed, box_size,
+                                num_particles=num_particles,
+                                sink=lambda fname, content: (
+                                    _atomic_sink(
+                                        out_dir, fname, content
+                                    )
+                                ),
+                            )
+                            counts.update(chunk_counts)
+                            nc_rows = _packed_probes(local_packed)[
+                                : max(len(part), 0), _HEAD_NC
+                            ]
+                            num_cliques += int(
+                                nc_rows.astype(np.int64).sum()
+                            )
+                        write_s += time.time() - t2
+                        _MICROGRAPHS.inc(len(part))
+                        for nm, _sets in part:
+                            journal.record(
+                                nm, "ok",
+                                wall_s=round(
+                                    chunk_s / max(len(part), 1), 6
+                                ),
+                                solver=solver,
+                                particles=counts.get(nm),
+                                out=nm + out_ext,
+                                **_gang_fields(),
+                            )
+                        telemetry.flush_run(run_tlm)
+                        tlm_server.set_ready(True)
+                        merged = cluster_ctx.merged_latest()
+                        q_count = sum(
+                            1
+                            for e in merged.values()
+                            if e.get("status")
+                            == STATUS_QUARANTINED
+                        )
+                        done = q_count + sum(
+                            1
+                            for e in merged.values()
+                            if e.get("status") in DONE_STATUSES
+                        )
+                        tlm_server.set_status(
+                            phase="running",
+                            chunks_done=len(parts),
+                            micrographs_done=done,
+                            quarantined=q_count,
+                        )
+                        tlm_trace.add_segment(
+                            "emit", t2, time.time() - t2,
+                            chunk=len(parts) - 1,
+                            micrographs=len(part),
+                        )
+                        cluster_ctx.crash_point(
+                            f"after_chunk:{ci}"
+                        )
+                        cluster_ctx.ensure_not_fenced()
+                    todo = []
+                except GangFault as gf:
+                    fault = gf
+                except (GangFenced, HostFenced):
+                    # presumed dead by the re-formed gang / fenced by
+                    # a survivor: stop — late writes lose by epoch
+                    raise
+                except ConsensusCancelled:
+                    raise
+                except Exception as e:  # noqa: BLE001 — gang ladder
+                    if strict:
+                        raise
+                    kind = classify_error(e)
+                    fault = GangFault(
+                        f"gang dispatch failed: {str(e)[:200]}",
+                        kind="dispatch_error",
+                        oom=(kind == "oom"),
+                    )
+                    sup.faults_seen += 1
+                    # the watchdog paths bump this inside dispatch;
+                    # dispatch_error classification happens here, so
+                    # the metric must follow or /metrics undercounts
+                    # vs /status and the journal
+                    telemetry.counter(
+                        "repic_gang_faults_total",
+                        "SPMD dispatches classified as gang faults",
+                    ).inc()
+                else:
+                    continue
+                # classified gang fault: journal it, then abort +
+                # re-form (or degrade once the fault budget is spent
+                # — a poison chunk must not reform forever)
+                sup.record_fault(
+                    fault, chunk=chunk_global or 0,
+                    context="consensus_dir",
+                )
+                remaining = _merged_remaining(todo_all)
+                if sup.faults_seen > sup.cfg.max_faults:
+                    sup.degrade(
+                        f"fault budget ({sup.cfg.max_faults}) "
+                        "exhausted"
+                    )
+                else:
+                    sup.reform(
+                        remaining,
+                        chunk=chunk_global or 0,
+                        oom=fault.oom,
+                    )
+                if sup.mode == "gang":
+                    # the epoch record's todo is adopted VERBATIM —
+                    # it exists precisely so every survivor walks
+                    # the same list (re-filtering against this
+                    # host's own merged view could disagree with a
+                    # peer's and desync the chunk count).  A name a
+                    # peer completed just before the fault is
+                    # reprocessed benignly: outputs are atomic and
+                    # content-identical, higher-epoch records win.
+                    rec_todo = sup.current_todo()
+                    todo = list(
+                        rec_todo
+                        if rec_todo is not None
+                        else remaining
+                    )
+                    rec_chunk = sup.current_chunk()
+                    if rec_chunk:
+                        chunk_global = rec_chunk
+                    caps["v"] = None  # re-probe on the new gang
+                    # the teardown cleared compiled executables (on
+                    # real multi-process gangs): the next dispatch
+                    # per signature recompiles and must get the
+                    # first-call deadline, not the warm one
+                    caps.get("executed", set()).clear()
+
+            if sup.mode != "independent":
+                return
+            # degraded: independent per-host execution over
+            # deterministic shares of the remainder, then a final
+            # sweep of anything still unclaimed (duplicates are
+            # benign: outputs are atomic and content-identical, and
+            # higher-epoch journal records win the fold)
+            use_mesh = False
+            n_dev = 1
+            for final_pass in (False, True):
+                remaining = _merged_remaining(todo_all)
+                if not remaining:
+                    break
+                mine = (
+                    remaining
+                    if final_pass
+                    else sup.independent_share(remaining)
+                )
+                if not mine:
+                    continue
+                share = _partition_loaded(mine, _load_many(mine))
+                if share:
+                    _process(share)
+
+        if gang_sup is not None:
+            _process_gang(todo_names)
+        elif loaded:
             _process(loaded)
         # Host ladder, reassignment rung: after draining its own
         # lease, a cluster host adopts work orphaned by dead peers
         # (heartbeat timeout -> suspect -> fence -> reassign) until
-        # nothing claimable remains.
-        while cluster_ctx is not None:
+        # nothing claimable remains.  Gang mode owns its todo
+        # collectively (degraded mode runs its own final sweep), so
+        # the lease-based harvest does not apply there.
+        while cluster_ctx is not None and gang_sup is None:
             orphans = cluster_ctx.harvest_orphans(
                 journal, names, strict=strict
             )
@@ -2060,6 +2645,15 @@ def run_consensus_dir(
         )
         if cluster_ctx is not None:
             stats["cluster"] = cluster_ctx.stats()
+        if gang_sup is not None:
+            stats["gang"] = {
+                "epoch": gang_sup.epoch,
+                "world": gang_sup.world,
+                "rank": gang_sup.rank,
+                "mode": gang_sup.mode,
+                "faults": gang_sup.faults_seen,
+                "reformations": gang_sup.reformations,
+            }
         stats["journal"] = journal.summary()
         journal.close()
         if len(parts) > 1:

@@ -68,6 +68,30 @@ site              raised at the matching call site
                   (as if another survivor fenced the dead replica
                   first), deterministically exercising the
                   "someone else owns this takeover" branch
+``gang_peer_crash`` no exception — polled by
+                  ``parallel.gang.GangSupervisor.dispatch`` right
+                  before the SPMD program launches; a firing
+                  terminates the process with ``os._exit(GANG_
+                  CRASH_EXIT_CODE)``: an abrupt peer loss
+                  mid-collective (no journal close, no heartbeat
+                  stop — every surviving peer is now blocked inside
+                  the collective).  Keys:
+                  ``<host>:gchunk:<epoch>:<i>`` (consensus chunks)
+                  and ``<host>:exchange`` (the capacity exchange)
+``gang_peer_stall`` no exception — polled in the gang dispatch
+                  thread; a firing wedges THIS host's dispatch
+                  (sleeps past any watchdog deadline), the
+                  deterministic stand-in for a peer stuck in a
+                  collective while its heartbeat keeps renewing.
+                  Keys: ``<host>:gchunk:<epoch>:<i>`` /
+                  ``<host>:exchange``
+``coordinator_loss`` no exception — polled by the gang watchdog
+                  wait loop; a firing makes the supervisor treat
+                  the distributed coordinator as unreachable and
+                  classify an immediate gang fault (abort +
+                  re-formation) without waiting out the deadline.
+                  Keys: ``<host>:gchunk:<epoch>:<i>`` /
+                  ``<host>:exchange``
 ``poison_job``    no exception — polled by
                   ``serve.jobs.poison_point`` right after the
                   worker binds a job to its input; a firing
@@ -121,6 +145,9 @@ KNOWN_SITES = (
     "replica_crash",
     "lease_steal",
     "poison_job",
+    "gang_peer_crash",
+    "gang_peer_stall",
+    "coordinator_loss",
 )
 
 

@@ -296,13 +296,14 @@ class RunJournal:
                 self._events.append(entry)
 
     def _load_merged(self) -> None:
-        """Cluster resume: fold EVERY host's journal (timestamp order,
-        last writer wins) into the latest-per-micrograph view."""
-        for entry in read_all_journals(self.out_dir):
-            if "name" in entry:
-                self._latest[entry["name"]] = entry
-            elif "event" in entry:
-                self._events.append(entry)
+        """Cluster resume: fold EVERY host's journal (timestamp
+        order, last writer wins, stale gang epochs fenced) into the
+        latest-per-micrograph view."""
+        entries = read_all_journals(self.out_dir)
+        self._latest.update(fold_latest(entries))
+        self._events.extend(
+            e for e in entries if "event" in e
+        )
 
 def read_journal(out_dir: str) -> list[dict]:
     """All journal entries of a run (test/inspection/report helper).
@@ -333,6 +334,44 @@ def _read_entries(path: str) -> list[dict]:
     return entries
 
 
+def _gang_epoch_of(entry: dict) -> "int | None":
+    """The entry's ``gang_epoch``, or None for non-gang records."""
+    raw = entry.get("gang_epoch")
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        return None
+
+
+def fold_latest(entries) -> dict[str, dict]:
+    """Last-writer-wins fold of micrograph records, epoch-fenced.
+
+    Entries arrive timestamp-sorted; the later record wins EXCEPT
+    when both records carry ``gang_epoch`` and the later one's is
+    LOWER — that is a fenced gang straggler unwedging after the
+    survivors re-formed, and its late writes must lose
+    (docs/robustness.md "Pod-scale gangs").  The epoch comparison
+    applies only between two gang records: a later non-gang run
+    (a plain ``--resume`` over a directory that once held a gang
+    run) overrides gang records by timestamp, exactly as any other
+    re-run would.
+    """
+    latest: dict[str, dict] = {}
+    for entry in entries:
+        name = entry.get("name")
+        if name is None:
+            continue
+        prev = latest.get(name)
+        if prev is not None:
+            pe, ce = _gang_epoch_of(prev), _gang_epoch_of(entry)
+            if pe is not None and ce is not None and ce < pe:
+                continue  # stale-epoch straggler loses
+        latest[name] = entry
+    return latest
+
+
 def read_all_journals(out_dir: str) -> list[dict]:
     """Merge-on-read over every journal file of a run.
 
@@ -340,9 +379,10 @@ def read_all_journals(out_dir: str) -> list[dict]:
     stable-sorted by timestamp so folding them front-to-back yields
     last-writer-wins semantics for micrographs recorded by more than
     one host (a reassignment after a false-positive suspicion, two
-    generations of a resumed run).  Each file tolerates a torn
-    trailing line — a crashed host's journal is exactly the file the
-    merge exists to read.
+    generations of a resumed run); :func:`fold_latest` additionally
+    fences stale gang epochs during the fold.  Each file tolerates a
+    torn trailing line — a crashed host's journal is exactly the
+    file the merge exists to read.
     """
     entries: list[dict] = []
     for path in journal_paths(out_dir):
@@ -352,12 +392,9 @@ def read_all_journals(out_dir: str) -> list[dict]:
 
 
 def merged_latest(out_dir: str) -> dict[str, dict]:
-    """Latest entry per micrograph over ALL hosts' journals."""
-    latest: dict[str, dict] = {}
-    for entry in read_all_journals(out_dir):
-        if "name" in entry:
-            latest[entry["name"]] = entry
-    return latest
+    """Latest entry per micrograph over ALL hosts' journals
+    (epoch-fenced — see :func:`fold_latest`)."""
+    return fold_latest(read_all_journals(out_dir))
 
 
 class MergedJournalReader:
@@ -390,7 +427,8 @@ class MergedJournalReader:
 
     def entries(self) -> list[dict]:
         """Every entry across the merged family, timestamp-sorted
-        (stable, so folding front-to-back is last-writer-wins)."""
+        (stable, so folding front-to-back is last-writer-wins;
+        :meth:`latest` additionally fences stale gang epochs)."""
         entries: list[dict] = []
         for _host, path in host_artifact_paths(
             self.out_dir, self.base_name
@@ -410,8 +448,4 @@ class MergedJournalReader:
         return entries
 
     def latest(self) -> dict[str, dict]:
-        latest: dict[str, dict] = {}
-        for entry in self.entries():
-            if "name" in entry:
-                latest[entry["name"]] = entry
-        return latest
+        return fold_latest(self.entries())

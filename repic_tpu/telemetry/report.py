@@ -102,7 +102,10 @@ def build_report(run_dir: str) -> dict:
     with per-host outcome tallies plus suspicion/fence/reassignment
     counts — what a fleet operator needs after a host loss.
     """
-    from repic_tpu.runtime.journal import read_all_journals
+    from repic_tpu.runtime.journal import (
+        fold_latest,
+        read_all_journals,
+    )
 
     if not os.path.isdir(run_dir):
         raise FileNotFoundError(f"run directory not found: {run_dir}")
@@ -132,9 +135,12 @@ def build_report(run_dir: str) -> dict:
     # fenced more than once, and the operator wants a host count
     suspect_hosts: set = set()
     fenced_hosts: set = set()
+    # gang transitions in journal order (docs/robustness.md
+    # "Pod-scale gangs"): the formed -> fault -> reformed/degraded
+    # sequence IS what the operator reads after a pod incident
+    gang_events: list = []
     for entry in journal:
         if "name" in entry:
-            latest[entry["name"]] = entry
             if "host" in entry:
                 clustered = True
         elif entry.get("event") == "chunk_retry":
@@ -155,6 +161,20 @@ def build_report(run_dir: str) -> dict:
             cluster["reassignments"]["micrographs"] += int(
                 entry.get("count", len(entry.get("names", ())))
             )
+        elif str(entry.get("event", "")).startswith("gang_"):
+            ev = {
+                "event": entry["event"],
+                "gang_epoch": entry.get("gang_epoch"),
+            }
+            for f in ("kind", "world", "dead", "host", "reason",
+                      "oom"):
+                if entry.get(f) not in (None, [], False):
+                    ev[f] = entry[f]
+            gang_events.append(ev)
+
+    # the epoch-fenced merged fold (a gang straggler's late records
+    # lose) — the same view --resume trusts
+    latest = fold_latest(journal)
 
     by_status: dict[str, int] = {}
     solver_rungs: dict[str, int] = {}
@@ -321,6 +341,29 @@ def build_report(run_dir: str) -> dict:
         if telemetry_by_host:
             cluster["telemetry"] = telemetry_by_host
         report["cluster"] = cluster
+    if gang_events:
+        report["gang"] = {
+            "events": gang_events,
+            "faults": sum(
+                1 for e in gang_events
+                if e["event"] == "gang_fault"
+            ),
+            "reformations": sum(
+                1 for e in gang_events
+                if e["event"] == "gang_reformed"
+            ),
+            "degraded": any(
+                e["event"] == "gang_degraded" for e in gang_events
+            ),
+            "final_epoch": max(
+                (
+                    int(e["gang_epoch"])
+                    for e in gang_events
+                    if e.get("gang_epoch") is not None
+                ),
+                default=None,
+            ),
+        }
     return report
 
 
@@ -385,6 +428,26 @@ def format_report(report: dict) -> str:
             f"reassigned={re_['micrographs']} "
             f"(in {re_['events']} event(s))"
         )
+
+    gang = report.get("gang")
+    if gang:
+        lines.append(
+            "gang: "
+            f"faults={gang['faults']} "
+            f"reformations={gang['reformations']} "
+            f"final_epoch={gang['final_epoch']}"
+            + (" DEGRADED" if gang["degraded"] else "")
+        )
+        for e in gang["events"]:
+            detail = " ".join(
+                f"{k}={e[k]}"
+                for k in ("kind", "world", "dead", "reason", "oom")
+                if k in e
+            )
+            lines.append(
+                f"  epoch {e.get('gang_epoch')}: {e['event']}"
+                + (f" ({detail})" if detail else "")
+            )
 
     if report["stages"]:
         lines.append("stage latencies (s):")

@@ -535,6 +535,34 @@ class StatusServer:
                 )
             except Exception:  # noqa: BLE001 - scrape never raises
                 pass
+        gang = doc.get("gang")
+        if isinstance(gang, dict) and gang.get("coordination_dir"):
+            # gang member liveness is recomputed per scrape, same as
+            # fleet/cluster: a peer lost mid-collective must read
+            # suspect here as soon as its heartbeat ages out, even
+            # while the survivors are still blocked in the program
+            try:
+                from repic_tpu.runtime.cluster import read_liveness
+
+                view = read_liveness(
+                    gang["coordination_dir"],
+                    float(gang.get("host_timeout_s", 10.0)),
+                )
+                doc["gang"] = dict(
+                    gang,
+                    members={
+                        h: {
+                            "rung": s.rung,
+                            "age_s": (
+                                None if s.age_s is None
+                                else round(s.age_s, 3)
+                            ),
+                        }
+                        for h, s in view.items()
+                    },
+                )
+            except Exception:  # noqa: BLE001 - scrape never raises
+                pass
         return doc
 
     def __enter__(self) -> "StatusServer":

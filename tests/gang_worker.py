@@ -1,0 +1,93 @@
+"""Worker process for the gang-scheduled SPMD chaos test.
+
+Launched N times by tests/test_gang_chaos.py with the standard JAX
+launch environment (``JAX_COORDINATOR_ADDRESS`` /
+``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``) plus cluster identity
+(``REPIC_TPU_HOST_ID`` / ``REPIC_TPU_HOST_RANK`` /
+``REPIC_TPU_NUM_HOSTS``).  All workers run ONE gang-scheduled
+``run_consensus_dir`` over the same shared input/output directories;
+the victim's environment plants ``gang_peer_crash`` so it dies via
+``os._exit(GANG_CRASH_EXIT_CODE)`` right as a chunk's collective
+launches — the deterministic SIGKILL-mid-collective.  Survivors must
+classify the gang fault, re-form a smaller gang, resume from the
+merged journals, and exit 0 with the full output set on disk.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("in_dir")
+    p.add_argument("out_dir")
+    p.add_argument("box_size", type=int)
+    p.add_argument("--heartbeat-interval", type=float, default=0.2)
+    p.add_argument("--host-timeout", type=float, default=2.0)
+    p.add_argument("--watchdog-floor", type=float, default=1.0)
+    p.add_argument("--first-deadline", type=float, default=120.0)
+    p.add_argument("--reform-timeout", type=float, default=60.0)
+    args = p.parse_args()
+
+    # One plain CPU device per worker: scrub the virtual-device flag
+    # inherited from the test conftest and force the CPU platform
+    # (same recipe as tests/distributed_worker.py).
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", flags
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("REPIC_TPU_NO_CACHE", "1")
+    # small chunks: the crash happens with real work remaining, so
+    # re-formation has something to resume
+    os.environ.setdefault("REPIC_CONSENSUS_CHUNK", "3")
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from repic_tpu.runtime import faults
+
+    faults.install_from_env()
+
+    from repic_tpu.parallel.gang import GangConfig
+    from repic_tpu.pipeline.consensus import run_consensus_dir
+    from repic_tpu.runtime.cluster import ClusterConfig
+
+    cluster = ClusterConfig(
+        coordination_dir=args.out_dir,
+        heartbeat_interval_s=args.heartbeat_interval,
+        host_timeout_s=args.host_timeout,
+    )
+    gang = GangConfig(
+        watchdog_factor=3.0,
+        watchdog_floor_s=args.watchdog_floor,
+        first_deadline_s=args.first_deadline,
+        max_extensions=1,
+        reform_timeout_s=args.reform_timeout,
+        host_timeout_s=args.host_timeout,
+    )
+    stats = run_consensus_dir(
+        args.in_dir,
+        args.out_dir,
+        args.box_size,
+        cluster=cluster,
+        gang=gang,
+    )
+    host = stats["cluster"]["host"]
+    with open(
+        os.path.join(args.out_dir, f"stats.{host}.json"), "w"
+    ) as f:
+        json.dump(stats, f, default=str)
+    print(json.dumps(
+        {"journal": stats["journal"], "gang": stats["gang"]},
+        default=str,
+    ))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

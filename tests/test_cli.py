@@ -391,6 +391,36 @@ def test_help_surfaces_observability_flags(capsys):
             assert flag in out, f"{cmd} lost {flag}"
 
 
+def test_help_surfaces_gang_flags(capsys):
+    """ISSUE 15 flag surface: gang-scheduled SPMD execution and its
+    watchdog / re-formation knobs stay registered on consensus."""
+    with pytest.raises(SystemExit):
+        cli_main(["consensus", "--help"])
+    out = capsys.readouterr().out
+    for flag in (
+        "--gang",
+        "--gang-min-world",
+        "--gang-watchdog-factor",
+        "--gang-watchdog-floor",
+        "--gang-first-deadline",
+        "--gang-reform-timeout",
+        "--gang-no-degrade",
+    ):
+        assert flag in out, f"consensus lost {flag}"
+
+
+def test_gang_knobs_require_gang_flag(tmp_path, capsys):
+    """Gang tuning flags without --gang fail fast with a structured
+    one-line error, before any filesystem mutation."""
+    with pytest.raises(SystemExit, match="require"):
+        cli_main([
+            "consensus", str(tmp_path / "in"),
+            str(tmp_path / "out"), "180",
+            "--gang-min-world", "2",
+        ])
+    assert not (tmp_path / "out").exists()
+
+
 def test_consensus_cli_device_time_and_status_port(tmp_path, rng):
     """End-to-end CLI smoke for the observability plane: a run with
     --device-time, --trace-dir, and an ephemeral --status-port

@@ -1,0 +1,220 @@
+"""Gang chaos: SIGKILL-equivalent peer loss mid-collective.
+
+The acceptance gate of the pod-scale gang path (docs/robustness.md
+"Pod-scale gangs"): three real ``jax.distributed`` worker processes
+execute one gang-scheduled consensus run; the victim dies via the
+``gang_peer_crash`` fault site (``os._exit`` as a chunk's collective
+launches — SIGKILL semantics: no journal close, no heartbeat stop,
+survivors blocked inside the program).  The survivors' watchdogs must
+classify the gang fault, fence the victim, re-form a two-host gang,
+resume from the merged journals, and produce BOX artifacts
+byte-identical to an uninterrupted single-process control run with
+zero lost and zero duplicated micrographs.
+
+Gated by the multiprocess capability probe (the sandbox CPU backend
+cannot run cross-process SPMD; the probe skips with the backend's own
+reason there and runs the test for real anywhere it can).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from repic_tpu.parallel.gang import GANG_CRASH_EXIT_CODE
+from repic_tpu.runtime.journal import (
+    DONE_STATUSES,
+    read_all_journals,
+)
+
+WORLD = 3
+MICROGRAPHS = 9
+PICKERS = ("alpha", "beta", "gamma")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _make_inputs(root) -> str:
+    """Deterministic multi-chunk workload: 3 pickers x 9 micrographs
+    of ~24 particles each (small enough for 1-device workers, large
+    enough that the injected crash leaves real work to resume)."""
+    rng = np.random.default_rng(7)
+    in_dir = os.path.join(root, "inputs")
+    for picker in PICKERS:
+        os.makedirs(os.path.join(in_dir, picker), exist_ok=True)
+    for m in range(MICROGRAPHS):
+        base = rng.uniform(80, 880, size=(24, 2))
+        for picker in PICKERS:
+            jitter = rng.uniform(-6, 6, size=base.shape)
+            conf = rng.uniform(0.1, 1.0, size=len(base))
+            rows = [
+                f"{x - 90:.2f}\t{y - 90:.2f}\t180\t180\t{c:.4f}"
+                for (x, y), c in zip(base + jitter, conf)
+            ]
+            path = os.path.join(
+                in_dir, picker, f"mic_{m:03d}.box"
+            )
+            with open(path, "w") as f:
+                f.write("\n".join(rows) + "\n")
+    return in_dir
+
+
+def _spawn_worker(repo_root, in_dir, out_dir, *, port, rank,
+                  extra_env=None):
+    env = dict(os.environ)
+    env.update(
+        JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+        JAX_NUM_PROCESSES=str(WORLD),
+        JAX_PROCESS_ID=str(rank),
+        REPIC_TPU_HOST_ID=f"gw{rank}",
+        REPIC_TPU_HOST_RANK=str(rank),
+        REPIC_TPU_NUM_HOSTS=str(WORLD),
+        PYTHONPATH=repo_root
+        + os.pathsep
+        + env.get("PYTHONPATH", ""),
+    )
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [
+            sys.executable,
+            os.path.join(
+                os.path.dirname(__file__), "gang_worker.py"
+            ),
+            in_dir,
+            out_dir,
+            "180",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+@pytest.mark.usefixtures("multiprocess_backend")
+def test_gang_survives_peer_killed_mid_collective(tmp_path):
+    repo_root = os.path.dirname(os.path.dirname(__file__))
+    in_dir = _make_inputs(str(tmp_path))
+
+    # Uninterrupted single-process control run: the byte-identity
+    # reference.  (Same config surface the gang run journals.)
+    control = os.path.join(str(tmp_path), "control")
+    proc = subprocess.run(
+        [
+            sys.executable, "-c",
+            "import sys; from repic_tpu.pipeline.consensus import "
+            "run_consensus_dir; run_consensus_dir(sys.argv[1], "
+            "sys.argv[2], 180, use_mesh=False)",
+            in_dir, control,
+        ],
+        env={
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "REPIC_TPU_NO_CACHE": "1",
+            "PYTHONPATH": repo_root
+            + os.pathsep
+            + os.environ.get("PYTHONPATH", ""),
+        },
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=repo_root,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[
+        -2000:
+    ]
+
+    # Chaos run: rank 2 dies as the SECOND chunk's collective
+    # launches (fault key `gchunk:1:1` = epoch 1, chunk index 1 —
+    # after journaling its chunk-0 share, so the survivors must
+    # both resume completed work and recover the remainder).
+    out_dir = os.path.join(str(tmp_path), "gang_out")
+    os.makedirs(out_dir, exist_ok=True)
+    port = _free_port()
+    workers = []
+    for rank in range(WORLD):
+        extra = (
+            {"REPIC_TPU_FAULTS": "gang_peer_crash:gchunk:1:1:1"}
+            if rank == 2
+            else {}
+        )
+        workers.append(
+            _spawn_worker(
+                repo_root, in_dir, out_dir,
+                port=port, rank=rank, extra_env=extra,
+            )
+        )
+    outs = []
+    for w in workers:
+        try:
+            out, _ = w.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for x in workers:
+                if x.poll() is None:
+                    x.kill()
+            out, _ = w.communicate()
+            out = (out or "") + "\n[chaos timeout]"
+        outs.append(out or "")
+
+    # the victim died from the injected crash, both survivors
+    # finished the run
+    assert workers[2].returncode == GANG_CRASH_EXIT_CODE, outs[2][
+        -3000:
+    ]
+    for rank in (0, 1):
+        assert workers[rank].returncode == 0, (
+            f"survivor {rank} failed:\n{outs[rank][-3000:]}"
+        )
+
+    # the journaled transition: fault -> re-formation at world 2
+    events = [
+        e for e in read_all_journals(out_dir) if "event" in e
+    ]
+    kinds = [e["event"] for e in events]
+    assert "gang_fault" in kinds, kinds
+    reformed = [e for e in events if e["event"] == "gang_reformed"]
+    assert reformed and all(
+        e["world"] == WORLD - 1 for e in reformed
+    ), reformed
+
+    # zero lost, zero duplicated: exactly one terminal record per
+    # micrograph in the epoch-aware merged fold, all ok
+    merged: dict = {}
+    for e in read_all_journals(out_dir):
+        if "name" in e:
+            merged[e["name"]] = e
+    names = sorted(merged)
+    assert names == sorted(
+        f"mic_{m:03d}" for m in range(MICROGRAPHS)
+    )
+    assert all(
+        merged[n]["status"] in DONE_STATUSES for n in names
+    ), {n: merged[n]["status"] for n in names}
+
+    # byte-identical artifacts vs the uninterrupted control
+    control_boxes = sorted(
+        f for f in os.listdir(control) if f.endswith(".box")
+    )
+    assert len(control_boxes) == MICROGRAPHS
+    for f in control_boxes:
+        got = open(os.path.join(out_dir, f)).read()
+        want = open(os.path.join(control, f)).read()
+        assert got == want, f"artifact drift in {f}"
+
+    # every surviving host reported the re-formed gang in its stats
+    for rank in (0, 1):
+        stats = json.load(
+            open(os.path.join(out_dir, f"stats.gw{rank}.json"))
+        )
+        assert stats["gang"]["mode"] in ("gang", "independent")
+        assert stats["gang"]["faults"] >= 1
