@@ -24,9 +24,12 @@ def add_arguments(parser: argparse.ArgumentParser) -> None:
         "Trace-time contract checker (rules RT101/RT102/RT103/RT105: "
         "eval_shape shape/dtype contracts, PartitionSpec axis "
         "consistency, donated-buffer use-after-donation, recompile "
-        "fingerprints).  Entry points register via "
-        "@repic_tpu.analysis.contracts.checked.  Exits non-zero on "
-        "findings; import failures are structured skips."
+        "fingerprints; plus RT421-RT425 Pallas kernel contracts — "
+        "grid/BlockSpec divisibility, index-map bounds, dtype/memory-"
+        "space consistency, output aliasing, interpret-mode "
+        "differential vs the pure-jnp reference).  Entry points "
+        "register via @repic_tpu.analysis.contracts.checked.  Exits "
+        "non-zero on findings; import failures are structured skips."
     )
     parser.add_argument(
         "paths",
@@ -38,7 +41,8 @@ def add_arguments(parser: argparse.ArgumentParser) -> None:
         "--select",
         default=None,
         metavar="RULES",
-        help="comma-separated RT1xx rule IDs to run (default: all)",
+        help="comma-separated RT1xx/RT42x rule IDs to run "
+        "(default: all)",
     )
     parser.add_argument(
         "--format",
@@ -59,6 +63,7 @@ def add_arguments(parser: argparse.ArgumentParser) -> None:
 
 
 def main(args: argparse.Namespace) -> None:
+    from repic_tpu.analysis.kernels import KERNEL_RULES
     from repic_tpu.analysis.semantic import SEMANTIC_RULES, run_check
 
     select = None
@@ -66,7 +71,7 @@ def main(args: argparse.Namespace) -> None:
         select = {
             s.strip().upper() for s in args.select.split(",") if s.strip()
         }
-        unknown = select - set(SEMANTIC_RULES)
+        unknown = select - set(SEMANTIC_RULES) - set(KERNEL_RULES)
         if unknown:
             sys.exit(f"unknown rule id(s): {', '.join(sorted(unknown))}")
     report = run_check(

@@ -27,9 +27,13 @@ def add_arguments(parser: argparse.ArgumentParser) -> None:
         "additionally runs the whole-program RT301-RT305 concurrency "
         "pass (unguarded shared writes, lock-order cycles, blocking "
         "under a lock, thread lifecycle, signal-handler safety); "
-        "with --deep, runs the trace-time semantic checker "
-        "(`repic-tpu check`, rules RT1xx) AND the concurrency pass "
-        "over the same paths."
+        "with --spmd, additionally runs the whole-program RT401-RT404 "
+        "SPMD-uniformity pass (host-divergent branches guarding "
+        "collectives, mismatched collective order, host syncs in "
+        "sharded entries, untagged gang journal writes); with --deep, "
+        "runs the trace-time semantic checker (`repic-tpu check`, "
+        "rules RT1xx plus the RT42x Pallas kernel contracts) AND the "
+        "concurrency AND spmd passes over the same paths."
     )
     parser.add_argument(
         "paths",
@@ -56,6 +60,13 @@ def add_arguments(parser: argparse.ArgumentParser) -> None:
         help="also run the whole-program RT3xx concurrency pass "
         "(stdlib-only, like lint itself; auto-enabled when --select "
         "names an RT3xx rule)",
+    )
+    parser.add_argument(
+        "--spmd",
+        action="store_true",
+        help="also run the whole-program RT4xx SPMD-uniformity pass "
+        "(stdlib-only, like lint itself; auto-enabled when --select "
+        "names an RT40x rule)",
     )
     parser.add_argument(
         "--hints",
@@ -88,12 +99,21 @@ def main(args: argparse.Namespace) -> None:
         run_paths,
     )
     from repic_tpu.analysis.rules import ALL_RULES
+    from repic_tpu.analysis.spmd import SPMD_RULES
 
     if args.list_rules:
+        from repic_tpu.analysis.kernels import KERNEL_RULES
+
         for rule in ALL_RULES:
             print(f"{rule.rule_id} [{rule.severity}] {rule.title}")
         for rule in CONCURRENCY_RULES.values():
             print(f"{rule.rule_id} [{rule.severity}] {rule.title}")
+        for rule in SPMD_RULES.values():
+            print(f"{rule.rule_id} [{rule.severity}] {rule.title}")
+        for rule_id, (severity, title, _hint) in sorted(
+            KERNEL_RULES.items()
+        ):
+            print(f"{rule_id} [{severity}] {title}")
         return
     select = None
     if args.select:
@@ -102,15 +122,20 @@ def main(args: argparse.Namespace) -> None:
         }
         known = {r.rule_id for r in ALL_RULES}
         known |= set(CONCURRENCY_RULES)
+        known |= set(SPMD_RULES)
         if args.deep:
+            from repic_tpu.analysis.kernels import KERNEL_RULES
             from repic_tpu.analysis.semantic import SEMANTIC_RULES
 
             known |= set(SEMANTIC_RULES)
+            known |= set(KERNEL_RULES)
         unknown = select - known
         if unknown:
             sys.exit(f"unknown rule id(s): {', '.join(sorted(unknown))}")
         if select & set(CONCURRENCY_RULES):
             args.concurrency = True
+        if select & set(SPMD_RULES):
+            args.spmd = True
     findings = run_paths(args.paths, select=select)
     if args.concurrency or args.deep:
         # whole-program RT3xx pass: still pure stdlib ast, but it
@@ -119,6 +144,12 @@ def main(args: argparse.Namespace) -> None:
         from repic_tpu.analysis.concurrency import run_concurrency
 
         findings.extend(run_concurrency(args.paths, select=select))
+    if args.spmd or args.deep:
+        # whole-program RT40x SPMD pass: same Program machinery,
+        # same stdlib-only discipline
+        from repic_tpu.analysis.spmd import run_spmd
+
+        findings.extend(run_spmd(args.paths, select=select))
     if args.deep:
         # the semantic pass imports JAX + the targets; lint alone
         # must stay import-free, so this lives behind the flag

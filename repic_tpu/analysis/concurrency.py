@@ -65,6 +65,7 @@ from repic_tpu.analysis.engine import (
     ImportMap,
     Rule,
     _line_suppresses,
+    call_span_map,
     decorator_line_map,
     dedupe_findings,
     iter_python_files,
@@ -298,6 +299,7 @@ class ModuleInfo:
         self.global_types: dict[str, str] = {}    # name -> dotted
         self.global_names: set = set()            # module-level binds
         self.dec_map = decorator_line_map(tree)
+        self.span_map = call_span_map(tree)
 
 
 def _module_aliases(path: str) -> list[str]:
@@ -1677,11 +1679,12 @@ def _suppressed(mod: ModuleInfo, f: Finding, extra_lines) -> bool:
     registration line)."""
     if _line_suppresses(mod.lines, f.line, f.rule):
         return True
-    rng = mod.dec_map.get(f.line)
-    if rng is not None and any(
-        _line_suppresses(mod.lines, ln, f.rule) for ln in rng
-    ):
-        return True
+    for m in (mod.dec_map, mod.span_map):
+        rng = m.get(f.line)
+        if rng is not None and any(
+            _line_suppresses(mod.lines, ln, f.rule) for ln in rng
+        ):
+            return True
     return any(
         _line_suppresses(mod.lines, ln, f.rule)
         for ln in extra_lines

@@ -101,6 +101,13 @@ class Contract:
         max_trace_variants: RT105 threshold — more than this many
             distinct static-argument signatures across call sites
             means that many separate XLA executables.
+        kernel: optional
+            :class:`repic_tpu.analysis.kernels.KernelContract` for
+            Pallas entry points — adds the RT42x structural checks
+            (grid/BlockSpec divisibility, index-map bounds, dtypes,
+            output aliasing) plus the interpret-mode differential
+            probe to ``repic-tpu check`` and KERNELCHECK.  Typed
+            ``object`` so this module keeps importing no JAX.
     """
 
     args: dict | None = None
@@ -112,6 +119,7 @@ class Contract:
     mesh_axes: tuple = ()
     donate: tuple = ()
     max_trace_variants: int = 4
+    kernel: object = None
 
 
 @dataclasses.dataclass

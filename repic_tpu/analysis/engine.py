@@ -481,23 +481,56 @@ def decorator_line_map(tree: ast.Module) -> dict:
     return out
 
 
+def call_span_map(tree: ast.Module) -> dict:
+    """first-lineno -> continuation-line range, for multi-line calls.
+
+    Findings anchor to a call's FIRST line (``node.lineno``), but the
+    natural place for a ``# repic: noqa[RTxxx]`` on a black-formatted
+    multi-line call is the closing-paren line — the only line with
+    room for a comment.  This map lets :func:`filter_suppressed` honor
+    a noqa on ANY line of the call expression.
+    """
+    out: dict[int, range] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        end = getattr(node, "end_lineno", None)
+        if end is None or end <= node.lineno:
+            continue
+        prev = out.get(node.lineno)
+        stop = max(end + 1, prev.stop if prev is not None else 0)
+        out[node.lineno] = range(node.lineno + 1, stop)
+    return out
+
+
 def filter_suppressed(
-    findings, lines: list[str], dec_map: dict | None = None
+    findings,
+    lines: list[str],
+    dec_map: dict | None = None,
+    span_map: dict | None = None,
 ) -> list:
     """Drop findings silenced by ``# repic: noqa`` comments.
 
     Checks the finding's own line, plus — for findings anchored to a
     decorated ``def`` line — the decorator lines above it
-    (:func:`decorator_line_map`).
+    (:func:`decorator_line_map`), plus — for findings anchored to the
+    first line of a multi-line call — the call's continuation lines
+    (:func:`call_span_map`), so a noqa on the closing-paren line
+    suppresses too.
     """
     out = []
     for f in findings:
         if _is_suppressed(f, lines):
             continue
-        rng = (dec_map or {}).get(f.line)
-        if rng is not None and any(
-            _line_suppresses(lines, ln, f.rule) for ln in rng
-        ):
+        suppressed = False
+        for m in (dec_map, span_map):
+            rng = (m or {}).get(f.line)
+            if rng is not None and any(
+                _line_suppresses(lines, ln, f.rule) for ln in rng
+            ):
+                suppressed = True
+                break
+        if suppressed:
             continue
         out.append(f)
     return out
@@ -534,7 +567,8 @@ def analyze_source(
             continue
         findings.extend(rule_cls().check(ctx))
     findings = filter_suppressed(
-        findings, ctx.lines, decorator_line_map(tree)
+        findings, ctx.lines, decorator_line_map(tree),
+        call_span_map(tree),
     )
     # stable report order; dedupe identical (rule, line, col) repeats
     # that loop-body double-passes can produce

@@ -1,0 +1,168 @@
+"""KERNELCHECK: the always-on Pallas differential sanitizer.
+
+The static RT42x pass (:mod:`repic_tpu.analysis.kernels`) proves a
+kernel's tiling plan is well-formed; it cannot prove the kernel MATH
+matches its reference — and the upcoming mega-kernel PRs (fused
+IoU -> clique join -> solve) will rewrite exactly that math, rung by
+rung.  KERNELCHECK is the dynamic gate, mirroring the LOCKCHECK
+pattern (:mod:`repic_tpu.analysis.lockcheck`): opt in with
+``REPIC_TPU_KERNELCHECK=1`` and every ``@checked`` entry whose
+:class:`~repic_tpu.analysis.contracts.Contract` declares a
+``kernel=`` :class:`~repic_tpu.analysis.kernels.KernelContract` is
+run ONCE in Pallas interpret mode against its pure-jnp reference —
+on the contract's own example inputs, across its full capacity-bucket
+shape ladder — at test-session start.  Divergence beyond the
+contract's tolerance is recorded as a violation; the pytest hooks in
+``tests/conftest.py`` print the report and fail the session, so a
+kernel that silently drifts from its reference cannot land green.
+
+Like LOCKCHECK, recording NEVER raises into the instrumented path:
+the probe runs once at install time, violations accumulate in a
+module-level list, and the session-level gate (not the probe) decides
+pass/fail.  CPU-only by construction — interpret mode needs no TPU.
+
+Usage::
+
+    REPIC_TPU_KERNELCHECK=1 pytest tests/test_pallas.py tests/test_gang.py
+
+or programmatically::
+
+    from repic_tpu.analysis import kernelcheck
+    kernelcheck.install()
+    kernelcheck.run_registered()
+    assert not kernelcheck.violations(), kernelcheck.report_text()
+"""
+
+from __future__ import annotations
+
+import contextlib
+import importlib
+import os
+
+#: opt-in switch, mirroring REPIC_TPU_LOCKCHECK
+ENV_VAR = "REPIC_TPU_KERNELCHECK"
+
+#: modules imported at install time so their ``@checked`` kernel
+#: entries self-register before the registry sweep
+DEFAULT_MODULES = ("repic_tpu.ops.iou_pallas",)
+
+_installed = False
+_violations: list[dict] = []
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_VAR, "") == "1"
+
+
+def install() -> bool:
+    """Arm the sanitizer.  Idempotent; returns True when active.
+
+    Installation only flips the flag — probing happens in
+    :func:`run_registered` so tests can arm without paying the probe
+    twice (``maybe_install_from_env`` does both)."""
+    global _installed
+    _installed = True
+    return True
+
+
+def uninstall() -> None:
+    global _installed
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def maybe_install_from_env() -> bool:
+    """Install + probe iff ``REPIC_TPU_KERNELCHECK=1`` (conftest)."""
+    if enabled():
+        install()
+        run_registered()
+        return True
+    return False
+
+
+def _record(kind: str, entry: str, detail: str) -> None:
+    _violations.append(
+        {"kind": kind, "entry": entry, "detail": detail}
+    )
+
+
+def run_registered(modules=DEFAULT_MODULES) -> int:
+    """Probe every registered kernel entry; returns #probed.
+
+    Never raises: import failures and probe errors become violations
+    (a sanitizer that crashes the session it guards is worse than the
+    bug it hunts)."""
+    from repic_tpu.analysis import contracts
+    from repic_tpu.analysis.kernels import differential_probe
+
+    for m in modules:
+        try:
+            importlib.import_module(m)
+        except Exception as e:
+            _record(
+                "kernel-import-error", m,
+                f"{type(e).__name__}: {e}",
+            )
+    probed = 0
+    for canonical, entry in sorted(contracts.registry().items()):
+        kc = getattr(entry.contract, "kernel", None)
+        if kc is None:
+            continue
+        probed += 1
+        for dims in kc.ladder:
+            try:
+                msgs = differential_probe(entry, kc, dims=dims)
+            except Exception as e:
+                _record(
+                    "kernel-probe-error", canonical,
+                    f"dims {dims}: {type(e).__name__}: {e}",
+                )
+                continue
+            for msg in msgs:
+                _record(
+                    "kernel-divergence", canonical,
+                    f"dims {dims}: {msg}",
+                )
+    return probed
+
+
+def violations() -> list[dict]:
+    return list(_violations)
+
+
+def reset() -> None:
+    """Clear recorded violations (test isolation)."""
+    _violations.clear()
+
+
+@contextlib.contextmanager
+def scoped():
+    """Isolate violations + installed flag (unit tests).
+
+    KERNELCHECK's own tests deliberately probe broken kernels;
+    without isolation those recordings would trip the session-level
+    gate in ``tests/conftest.py``.  Snapshots on entry, restores on
+    exit."""
+    global _installed
+    snap = list(_violations)
+    was = _installed
+    try:
+        yield
+    finally:
+        _violations[:] = snap
+        _installed = was
+
+
+def report_text() -> str:
+    """Human-readable violation report (printed by the pytest hook)."""
+    out = []
+    for v in violations():
+        out.append(
+            f"KERNELCHECK {v['kind']} [{v['entry']}]: {v['detail']}"
+        )
+    if not out:
+        return "KERNELCHECK: no violations"
+    return "\n".join(out)

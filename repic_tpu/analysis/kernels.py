@@ -1,0 +1,548 @@
+"""Trace-time Pallas kernel contracts: the RT42x rule pack.
+
+A Pallas kernel fails differently from a jitted function: a BlockSpec
+whose block shape does not divide the padded operand, an index map
+that addresses past the array edge, or a dtype mismatch between the
+kernel's output and its reference produces garbage lanes or a Mosaic
+lowering error ON THE TPU — after the job is scheduled, on hardware
+the CI container cannot reach.  The RT42x checks move all of that to
+trace time on CPU:
+
+RT421  grid/BlockSpec divisibility: for every rung of the contract's
+       capacity-bucket shape ladder, every block shape must divide
+       its operand's padded shape exactly (grid * block == padded),
+       and VMEM blocks of rank >= 2 must be (8, 128)-tile aligned —
+       the float32 minimum tile; an unaligned layout relies on
+       implicit padding the TPU lowering does not guarantee.
+RT422  index-map bounds: each BlockSpec's index map is enumerated
+       over the grid (capped at ``max_probe_points`` points; corners
+       beyond that) and must return in-range block indices of the
+       right arity — ``(idx + 1) * block <= padded`` in every dim.
+RT423  dtype/memory-space consistency: declared dtypes must be real
+       dtypes, SMEM blocks stay small/low-rank (scalar prologue
+       memory), and the kernel's eval_shape output must structurally
+       match the reference's (same tree, shapes, dtypes) — the
+       contract both sides of the differential probe rely on.
+RT424  output-aliasing declarations: ``donate``/``out_aliases`` pairs
+       must name real operands and alias buffers of identical padded
+       shape + dtype (XLA rejects mismatched aliases at dispatch
+       time, on the TPU you don't have).
+RT425  interpret-mode differential: the kernel runs in Pallas
+       interpret mode on the ladder's example inputs and must match
+       its pure-jnp reference within the contract's tolerance — the
+       same probe KERNELCHECK (:mod:`repic_tpu.analysis.kernelcheck`)
+       runs at test-session start.
+
+The plan half (RT421/RT422/RT424) is pure Python over the contract's
+declared :class:`KernelPlan` — no JAX at all.  RT423/RT425 import JAX
+lazily inside ``repic-tpu check``'s existing skip discipline: an
+unavailable backend is a structured skip, never a finding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+
+from repic_tpu.analysis.engine import Finding
+
+# rule id -> (severity, title, fix hint)
+KERNEL_RULES = {
+    "RT421": (
+        "error",
+        "BlockSpec/grid divisibility or TPU tile alignment violated",
+        "pick block shapes that divide the padded operand exactly "
+        "and keep rank>=2 VMEM blocks (8, 128)-tile aligned; pad the "
+        "operand up, never rely on implicit lowering padding",
+    ),
+    "RT422": (
+        "error",
+        "BlockSpec index map addresses outside the padded operand",
+        "index maps return BLOCK indices: (idx + 1) * block_shape "
+        "must stay <= the padded shape in every dim for every grid "
+        "point",
+    ),
+    "RT423": (
+        "error",
+        "kernel dtype/memory-space inconsistent with its reference",
+        "align the kernel's output shapes/dtypes with the reference "
+        "(or fix the contract); the differential probe can only "
+        "compare structurally identical outputs",
+    ),
+    "RT424": (
+        "error",
+        "output-aliasing declaration names mismatched buffers",
+        "alias only an input whose padded shape and dtype equal the "
+        "output's — XLA rejects mismatched donation at dispatch time",
+    ),
+    "RT425": (
+        "error",
+        "kernel diverges from its reference in interpret mode",
+        "run the kernel under interpret=True against the pure-jnp "
+        "reference locally (see docs/static_analysis.md, KERNELCHECK "
+        "runbook); fix the kernel math or loosen the contract's tol "
+        "with a comment explaining the numerics",
+    ),
+}
+
+
+def _finding(rule, path, line, message) -> Finding:
+    severity, _title, hint = KERNEL_RULES[rule]
+    return Finding(
+        rule=rule,
+        severity=severity,
+        message=message,
+        hint=hint,
+        path=path,
+        line=line,
+        col=0,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockPlan:
+    """One operand's BlockSpec as the contract declares it.
+
+    ``block_shape``/``index_map`` of ``None`` means a whole-array
+    block (the SMEM scalar-prologue idiom).  ``padded_shape`` is the
+    operand AFTER the wrapper's tile padding — the shape the
+    BlockSpec actually carves.
+    """
+
+    name: str
+    block_shape: tuple | None
+    index_map: object  # callable(*grid) -> block indices, or None
+    padded_shape: tuple
+    dtype: str = "float32"
+    memory_space: str = "vmem"
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelPlan:
+    """The grid + BlockSpecs one ladder rung resolves to."""
+
+    grid: tuple
+    in_blocks: tuple
+    out_blocks: tuple
+    # output index -> input name whose buffer it aliases/donates
+    out_aliases: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelContract:
+    """What RT42x + KERNELCHECK verify about one Pallas entry.
+
+    Args:
+        plan: ``dims dict -> KernelPlan`` replicating the wrapper's
+            tiling math (grid, padded shapes, BlockSpecs) for one
+            rung.  Pure Python — called with every ladder rung.
+        ladder: dims dicts to validate — the capacity-bucket shape
+            ladder the serving path actually pads to, plus at least
+            one ragged rung (shapes that need padding).
+        make_inputs: ``dims dict -> (args tuple, kwargs dict)``
+            building CONCRETE inputs for the differential probe.  May
+            import numpy/jax; called lazily.
+        reference: pure-jnp callable with the entry's signature —
+            the ground truth the kernel must match.
+        run: optional override callable for the kernel side; defaults
+            to ``entry.fn`` with the contract's static kwargs (which
+            must force ``interpret=True`` for CPU probing).
+        compare: optional ``(got, want, tol) -> list[str]`` custom
+            comparator (e.g. skip tie-broken index columns); default
+            is allclose over the flattened pytree.
+        tol: absolute tolerance for the default comparator.
+        min_tile: minimum TPU tile for rank>=2 VMEM blocks.
+        max_probe_points: full-grid index-map enumeration cap; larger
+            grids probe corners + edges only.
+    """
+
+    plan: object
+    ladder: tuple
+    make_inputs: object
+    reference: object
+    run: object = None
+    compare: object = None
+    tol: float = 1e-6
+    min_tile: tuple = (8, 128)
+    max_probe_points: int = 4096
+
+
+# -- RT421/RT422/RT424: pure-Python plan validation -------------------
+
+
+def _check_block_plan(kc, dims, plan, which, bp, path, line, findings):
+    """RT421 for one BlockPlan of one rung."""
+    where = f"{which} '{bp.name}' (dims {dims})"
+    if bp.block_shape is None:
+        return
+    if len(bp.block_shape) != len(bp.padded_shape):
+        findings.append(
+            _finding(
+                "RT421", path, line,
+                f"{where}: block shape {bp.block_shape} has rank "
+                f"{len(bp.block_shape)} but the padded operand is "
+                f"rank {len(bp.padded_shape)} ({bp.padded_shape})",
+            )
+        )
+        return
+    for k, (b, p) in enumerate(zip(bp.block_shape, bp.padded_shape)):
+        if b <= 0 or p % b != 0:
+            findings.append(
+                _finding(
+                    "RT421", path, line,
+                    f"{where}: block dim {k} is {b}, which does not "
+                    f"divide the padded extent {p} — the last block "
+                    f"would read past the operand",
+                )
+            )
+    if bp.memory_space == "vmem" and len(bp.block_shape) >= 2:
+        sub, lane = bp.block_shape[-2], bp.block_shape[-1]
+        msub, mlane = kc.min_tile
+        # sub == 1 is the broadcast-row idiom ((1, TN) candidate
+        # tiles); anything between 1 and a full sublane tile is not
+        if (sub != 1 and sub % msub != 0) or lane % mlane != 0:
+            findings.append(
+                _finding(
+                    "RT421", path, line,
+                    f"{where}: block {bp.block_shape} is not "
+                    f"({msub}, {mlane})-tile aligned — implicit "
+                    f"lane/sublane padding is not guaranteed by the "
+                    f"TPU lowering",
+                )
+            )
+
+
+def _grid_points(grid, cap):
+    """Every grid point when small; corners + axis extremes beyond
+    ``cap`` (the bound-violating maps break at extremes)."""
+    total = 1
+    for g in grid:
+        total *= max(g, 1)
+    if total <= cap:
+        return list(
+            itertools.product(*(range(max(g, 1)) for g in grid))
+        )
+    corners = itertools.product(
+        *((0, max(g - 1, 0)) for g in grid)
+    )
+    return sorted(set(corners))
+
+
+def _check_index_maps(kc, dims, plan, path, line, findings):
+    """RT422 for one rung: enumerate the grid through every map."""
+    points = _grid_points(plan.grid, kc.max_probe_points)
+    for which, blocks in (
+        ("in_spec", plan.in_blocks), ("out_spec", plan.out_blocks)
+    ):
+        for bp in blocks:
+            if bp.index_map is None or bp.block_shape is None:
+                continue
+            for pt in points:
+                try:
+                    idx = bp.index_map(*pt)
+                except TypeError as e:
+                    findings.append(
+                        _finding(
+                            "RT422", path, line,
+                            f"{which} '{bp.name}' (dims {dims}): "
+                            f"index map arity does not match grid "
+                            f"rank {len(plan.grid)}: {e}",
+                        )
+                    )
+                    break
+                idx = (
+                    tuple(idx)
+                    if isinstance(idx, (tuple, list))
+                    else (idx,)
+                )
+                if len(idx) != len(bp.block_shape):
+                    findings.append(
+                        _finding(
+                            "RT422", path, line,
+                            f"{which} '{bp.name}' (dims {dims}): "
+                            f"index map returned {len(idx)} indices "
+                            f"for a rank-{len(bp.block_shape)} block",
+                        )
+                    )
+                    break
+                bad = [
+                    k
+                    for k, (i, b, p) in enumerate(
+                        zip(idx, bp.block_shape, bp.padded_shape)
+                    )
+                    if i < 0 or (i + 1) * b > p
+                ]
+                if bad:
+                    k = bad[0]
+                    findings.append(
+                        _finding(
+                            "RT422", path, line,
+                            f"{which} '{bp.name}' (dims {dims}): at "
+                            f"grid point {pt} the map returns block "
+                            f"index {idx[k]} in dim {k} — "
+                            f"({idx[k]} + 1) * {bp.block_shape[k]} > "
+                            f"padded extent {bp.padded_shape[k]}",
+                        )
+                    )
+                    break
+
+
+def _check_aliases(dims, plan, path, line, findings):
+    """RT424 for one rung."""
+    by_name = {bp.name: bp for bp in plan.in_blocks}
+    for out_idx, in_name in sorted(plan.out_aliases.items()):
+        if not (
+            isinstance(out_idx, int)
+            and 0 <= out_idx < len(plan.out_blocks)
+        ):
+            findings.append(
+                _finding(
+                    "RT424", path, line,
+                    f"out_aliases (dims {dims}): output index "
+                    f"{out_idx} out of range for "
+                    f"{len(plan.out_blocks)} outputs",
+                )
+            )
+            continue
+        src = by_name.get(in_name)
+        if src is None:
+            findings.append(
+                _finding(
+                    "RT424", path, line,
+                    f"out_aliases (dims {dims}): no input named "
+                    f"'{in_name}' to alias output {out_idx} onto",
+                )
+            )
+            continue
+        dst = plan.out_blocks[out_idx]
+        if (
+            src.padded_shape != dst.padded_shape
+            or src.dtype != dst.dtype
+        ):
+            findings.append(
+                _finding(
+                    "RT424", path, line,
+                    f"out_aliases (dims {dims}): output {out_idx} "
+                    f"({dst.padded_shape}, {dst.dtype}) aliases "
+                    f"input '{in_name}' ({src.padded_shape}, "
+                    f"{src.dtype}) — shapes/dtypes must match "
+                    f"exactly for XLA buffer donation",
+                )
+            )
+
+
+_VALID_DTYPES = {
+    "float32", "float64", "float16", "bfloat16",
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool",
+}
+
+
+def _check_dtypes_static(dims, plan, path, line, findings):
+    """The JAX-free half of RT423: dtype names + SMEM discipline."""
+    for which, blocks in (
+        ("in_spec", plan.in_blocks), ("out_spec", plan.out_blocks)
+    ):
+        for bp in blocks:
+            if bp.dtype not in _VALID_DTYPES:
+                findings.append(
+                    _finding(
+                        "RT423", path, line,
+                        f"{which} '{bp.name}' (dims {dims}): "
+                        f"'{bp.dtype}' is not a known dtype name",
+                    )
+                )
+            if bp.memory_space == "smem":
+                if len(bp.padded_shape) > 2:
+                    findings.append(
+                        _finding(
+                            "RT423", path, line,
+                            f"{which} '{bp.name}' (dims {dims}): "
+                            f"SMEM block of rank "
+                            f"{len(bp.padded_shape)} — SMEM is "
+                            f"scalar-prologue memory, keep it rank "
+                            f"<= 2",
+                        )
+                    )
+
+
+# -- RT423 (dynamic half) + RT425: interpret-mode probes --------------
+
+
+def _kernel_callable(entry, kc):
+    import functools
+
+    if kc.run is not None:
+        return kc.run
+    return functools.partial(entry.fn, **entry.contract.static)
+
+
+def _flatten(tree):
+    """Pytree leaves without importing jax.tree_util eagerly."""
+    if isinstance(tree, (tuple, list)):
+        out = []
+        for t in tree:
+            out.extend(_flatten(t))
+        return out
+    if isinstance(tree, dict):
+        out = []
+        for k in sorted(tree):
+            out.extend(_flatten(tree[k]))
+        return out
+    return [tree]
+
+
+def _default_compare(got, want, tol) -> list[str]:
+    import numpy as np
+
+    gl, wl = _flatten(got), _flatten(want)
+    if len(gl) != len(wl):
+        return [
+            f"output arity mismatch: kernel returned {len(gl)} "
+            f"leaves, reference {len(wl)}"
+        ]
+    msgs = []
+    for i, (g, w) in enumerate(zip(gl, wl)):
+        g, w = np.asarray(g), np.asarray(w)
+        if g.shape != w.shape or g.dtype != w.dtype:
+            msgs.append(
+                f"leaf {i}: kernel ({g.shape}, {g.dtype}) vs "
+                f"reference ({w.shape}, {w.dtype})"
+            )
+            continue
+        if not np.allclose(g, w, atol=tol, rtol=0.0):
+            delta = float(
+                np.max(np.abs(g.astype("float64") - w.astype(
+                    "float64"
+                )))
+            )
+            msgs.append(
+                f"leaf {i}: max |kernel - reference| = {delta:.3g} "
+                f"> tol {tol:g}"
+            )
+    return msgs
+
+
+def differential_probe(entry, kc, dims=None) -> list[str]:
+    """Run kernel vs reference on one rung's concrete inputs.
+
+    Returns divergence messages ([] when they agree).  Shared verbatim
+    between RT425 (``repic-tpu check``) and the KERNELCHECK sanitizer.
+    Raises whatever the builder/kernel raises — callers own the skip
+    discipline.
+    """
+    rung = dims if dims is not None else kc.ladder[0]
+    args, kwargs = kc.make_inputs(rung)
+    got = _kernel_callable(entry, kc)(*args, **kwargs)
+    want = kc.reference(*args, **kwargs)
+    cmp = kc.compare if kc.compare is not None else _default_compare
+    return cmp(got, want, kc.tol)
+
+
+def _probe_structure(entry, kc, path, line, findings) -> bool:
+    """Dynamic RT423: eval_shape kernel vs reference on rung 0.
+    Returns False on an environment skip (caller records it)."""
+    import jax
+
+    rung = kc.ladder[0]
+    args, kwargs = kc.make_inputs(rung)
+    got = jax.eval_shape(_kernel_callable(entry, kc), *args, **kwargs)
+    want = jax.eval_shape(kc.reference, *args, **kwargs)
+    gl, wl = _flatten(got), _flatten(want)
+    ok = len(gl) == len(wl) and all(
+        g.shape == w.shape and g.dtype == w.dtype
+        for g, w in zip(gl, wl)
+    )
+    if not ok:
+        findings.append(
+            _finding(
+                "RT423", path, line,
+                f"{entry.name}(): kernel output structure "
+                f"{[(g.shape, str(g.dtype)) for g in gl]} does not "
+                f"match the reference "
+                f"{[(w.shape, str(w.dtype)) for w in wl]} (dims "
+                f"{rung})",
+            )
+        )
+    return True
+
+
+# -- entry point (called from semantic.run_check) ---------------------
+
+
+def run_kernel_checks(entry, path, findings, skipped, want) -> None:
+    """All RT42x checks for one ``@checked`` entry with a
+    ``Contract.kernel``.  Follows ``repic-tpu check``'s skip
+    discipline: backend/import limitations are structured skips."""
+    kc = entry.contract.kernel
+    line = entry.lineno
+
+    # plan half: pure Python, runs everywhere
+    for dims in kc.ladder:
+        try:
+            plan = kc.plan(dict(dims))
+        except Exception as e:
+            findings.append(
+                _finding(
+                    "RT421", path, line,
+                    f"{entry.name}(): plan builder failed on dims "
+                    f"{dims}: {type(e).__name__}: {e}",
+                )
+            )
+            continue
+        if want("RT421"):
+            for which, blocks in (
+                ("in_spec", plan.in_blocks),
+                ("out_spec", plan.out_blocks),
+            ):
+                for bp in blocks:
+                    _check_block_plan(
+                        kc, dims, plan, which, bp, path, line,
+                        findings,
+                    )
+        if want("RT422"):
+            _check_index_maps(kc, dims, plan, path, line, findings)
+        if want("RT423"):
+            _check_dtypes_static(dims, plan, path, line, findings)
+        if want("RT424"):
+            _check_aliases(dims, plan, path, line, findings)
+
+    # dynamic half: jax-lazy, skip on environment limitation
+    for rule, probe in (
+        ("RT423", lambda: _probe_structure(
+            entry, kc, path, line, findings
+        )),
+        ("RT425", None),
+    ):
+        if not want(rule):
+            continue
+        try:
+            if rule == "RT423":
+                probe()
+            else:
+                for msg in differential_probe(entry, kc):
+                    findings.append(
+                        _finding(
+                            "RT425", path, line,
+                            f"{entry.name}(): interpret-mode kernel "
+                            f"diverges from its reference — {msg}",
+                        )
+                    )
+        except (RuntimeError, OSError, ImportError) as e:
+            skipped.append(
+                {
+                    "entry": entry.canonical,
+                    "reason": (
+                        f"kernel-probe-unavailable[{rule}]: "
+                        f"{type(e).__name__}: {e}"
+                    ),
+                }
+            )
+        except Exception as e:
+            findings.append(
+                _finding(
+                    rule, path, line,
+                    f"{entry.name}(): kernel probe failed — "
+                    f"{type(e).__name__}: {e}",
+                )
+            )

@@ -4,7 +4,8 @@ GitHub code scanning (and most SARIF viewers) can ingest the output
 of ``repic-tpu lint --format sarif``: one run, one driver
 (``repic-tpu-lint``), a rule table assembled from every pack that can
 contribute findings (RT0xx/RT2xx per-file lint, RT1xx semantic check
-via ``--deep``, RT3xx concurrency via ``--concurrency``), and one
+and RT42x kernel contracts via ``--deep``, RT3xx concurrency via
+``--concurrency``, RT40x SPMD uniformity via ``--spmd``), and one
 result per finding with a physical location.  Pure stdlib — the
 renderer must work in the dependency-free CI lint job.
 
@@ -32,8 +33,10 @@ def _known_rules() -> dict:
     """id -> (severity, title, hint) for every rule pack that can
     contribute findings to a lint report."""
     from repic_tpu.analysis.concurrency import CONCURRENCY_RULES
+    from repic_tpu.analysis.kernels import KERNEL_RULES
     from repic_tpu.analysis.rules import ALL_RULES
     from repic_tpu.analysis.semantic import SEMANTIC_RULES
+    from repic_tpu.analysis.spmd import SPMD_RULES
 
     out = {
         "RT000": (
@@ -46,9 +49,13 @@ def _known_rules() -> dict:
         out[rule.rule_id] = (rule.severity, rule.title, rule.hint)
     for rule in CONCURRENCY_RULES.values():
         out[rule.rule_id] = (rule.severity, rule.title, rule.hint)
+    for rule in SPMD_RULES.values():
+        out[rule.rule_id] = (rule.severity, rule.title, rule.hint)
     for rule_id, (severity, hint) in SEMANTIC_RULES.items():
         out[rule_id] = (severity, f"trace-time contract {rule_id}",
                         hint)
+    for rule_id, (severity, title, hint) in KERNEL_RULES.items():
+        out[rule_id] = (severity, title, hint)
     return out
 
 

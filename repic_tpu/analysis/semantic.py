@@ -38,6 +38,7 @@ import sys
 from repic_tpu.analysis.engine import (
     Finding,
     ImportMap,
+    call_span_map,
     decorator_line_map,
     filter_suppressed,
     function_owner_map as _owner_map,
@@ -648,6 +649,20 @@ def run_check(paths, select=None, collect_only=False) -> CheckReport:
             _check_sharding(entry, findings)
         if want("RT101"):
             _check_entry(entry, findings, skipped)
+        if getattr(entry.contract, "kernel", None) is not None:
+            from repic_tpu.analysis.kernels import (
+                KERNEL_RULES,
+                run_kernel_checks,
+            )
+
+            if any(want(r) for r in KERNEL_RULES):
+                run_kernel_checks(
+                    entry,
+                    _entry_path(entry) or entry.module,
+                    findings,
+                    skipped,
+                    want,
+                )
 
     # parse once for the call-site scans and noqa suppression
     parsed = {}
@@ -689,7 +704,8 @@ def run_check(paths, select=None, collect_only=False) -> CheckReport:
         tree, _imap, src = entry_src
         kept.extend(
             filter_suppressed(
-                group, src.splitlines(), decorator_line_map(tree)
+                group, src.splitlines(), decorator_line_map(tree),
+                call_span_map(tree),
             )
         )
     seen = set()

@@ -49,6 +49,13 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from repic_tpu.analysis.contracts import Contract, checked, spec
+from repic_tpu.analysis.kernels import (
+    BlockPlan,
+    KernelContract,
+    KernelPlan,
+)
+
 NEG = -1.0  # sentinel value for empty top-D slots (any IoU is >= 0)
 LANE = 128  # TPU lane width; all trailing block dims align to this
 # Fail-fast ceiling for direct callers: the merge is d sequential
@@ -161,6 +168,139 @@ def _neighbor_kernel(
     ti_ref[:] = jnp.where(lane == d, cnt, out_i)  # count rides lane d
 
 
+# -- contract (RT42x + KERNELCHECK) -----------------------------------
+# The probe pins the wrapper's defaults-at-test-scale: d=8, tile 64 x
+# 128, interpret mode (CPU).  _plan replicates the wrapper's tiling
+# math EXACTLY for those statics — if the wrapper's rounding ever
+# drifts from the plan, RT421/RT422 fail on the ladder before the
+# kernel is ever dispatched.
+
+_PROBE_D = 8
+_PROBE_TM = 64
+_PROBE_TN = 128
+_PROBE_BOX = 180.0
+_PROBE_THRESHOLD = 0.3
+
+
+def _plan(dims: dict) -> KernelPlan:
+    n, m = dims["N"], dims["M"]
+    d = _PROBE_D
+    w = -(-(d + 1) // LANE) * LANE
+    tm = min(-(-_PROBE_TM // 8) * 8, -(-n // 8) * 8)
+    tn = min(-(-_PROBE_TN // LANE) * LANE, -(-m // LANE) * LANE)
+    np_, mp = n + (-n % tm), m + (-m % tn)
+    cand = lambda i, j: (0, j)  # noqa: E731 — the wrapper's own shape
+    return KernelPlan(
+        grid=(np_ // tm, mp // tn),
+        in_blocks=(
+            BlockPlan(
+                "sizes", None, None, (2,), memory_space="smem"
+            ),
+            BlockPlan(
+                "a_pack", (tm, LANE), lambda i, j: (i, 0),
+                (np_, LANE),
+            ),
+            BlockPlan("bx", (1, tn), cand, (1, mp)),
+            BlockPlan("by", (1, tn), cand, (1, mp)),
+            BlockPlan("bm", (1, tn), cand, (1, mp)),
+        ),
+        out_blocks=(
+            BlockPlan(
+                "tv", (tm, w), lambda i, j: (i, 0), (np_, w)
+            ),
+            BlockPlan(
+                "ti", (tm, w), lambda i, j: (i, 0), (np_, w),
+                dtype="int32",
+            ),
+        ),
+    )
+
+
+def _probe_inputs(dims: dict):
+    import numpy as np
+
+    n, m = dims["N"], dims["M"]
+    rng = np.random.default_rng(n + m)
+    xa = jnp.asarray(rng.uniform(0, 2000.0, (n, 2)), jnp.float32)
+    xb = jnp.asarray(rng.uniform(0, 2000.0, (m, 2)), jnp.float32)
+    ma = jnp.asarray(rng.uniform(size=n) > 0.15)
+    mb = jnp.asarray(rng.uniform(size=m) > 0.15)
+    return (xa, ma, xb, mb, _PROBE_BOX, _PROBE_BOX), {}
+
+
+def _reference(xy_a, mask_a, xy_b, mask_b, size_a, size_b):
+    """Ground truth: the dense XLA path this kernel fuses away."""
+    from repic_tpu.ops.iou import pairwise_iou_matrix
+
+    iou = pairwise_iou_matrix(
+        xy_a, mask_a, xy_b, mask_b, size_a, size_b
+    )
+    v, i = jax.lax.top_k(iou, _PROBE_D)
+    cnt = jnp.sum(iou > _PROBE_THRESHOLD, axis=1).astype(jnp.int32)
+    return v, i, cnt
+
+
+def _compare(got, want, tol):
+    """Values (sentinel-clamped) + adjacency counts; indices are
+    skipped — zero-IoU candidates form large tie classes and the
+    kernel's min-position tie-break legitimately differs from
+    top_k's."""
+    import numpy as np
+
+    tv, _ti, cnt = got
+    rv, _ri, rc = want
+    msgs = []
+    tvc = np.where(np.asarray(tv) < 0, 0.0, np.asarray(tv))
+    if not np.allclose(tvc, np.asarray(rv), atol=tol, rtol=0.0):
+        delta = float(np.max(np.abs(tvc - np.asarray(rv))))
+        msgs.append(
+            f"top-{_PROBE_D} IoU values: max |kernel - reference| "
+            f"= {delta:.3g} > tol {tol:g}"
+        )
+    if not np.array_equal(np.asarray(cnt), np.asarray(rc)):
+        bad = int(
+            np.sum(np.asarray(cnt) != np.asarray(rc))
+        )
+        msgs.append(
+            f"adjacency counts differ for {bad} anchor(s)"
+        )
+    return msgs
+
+
+@checked(Contract(
+    args={
+        "xy_a": spec("N 2"),
+        "mask_a": spec("N", "bool"),
+        "xy_b": spec("M 2"),
+        "mask_b": spec("M", "bool"),
+        "size_a": spec(""),
+        "size_b": spec(""),
+    },
+    returns=(
+        spec("N 8"), spec("N 8", "int32"), spec("N", "int32")
+    ),
+    dims={"N": 40, "M": 70},
+    static={
+        "d": _PROBE_D,
+        "threshold": _PROBE_THRESHOLD,
+        "tile_m": _PROBE_TM,
+        "tile_n": _PROBE_TN,
+        "interpret": True,
+    },
+    kernel=KernelContract(
+        plan=_plan,
+        # bucket-aligned rungs plus a ragged one (padding exercised)
+        ladder=(
+            {"N": 64, "M": 128},
+            {"N": 96, "M": 256},
+            {"N": 40, "M": 70},
+        ),
+        make_inputs=_probe_inputs,
+        reference=_reference,
+        compare=_compare,
+        tol=1e-6,
+    ),
+))
 @functools.partial(
     jax.jit,
     static_argnames=(

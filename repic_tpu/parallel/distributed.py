@@ -125,7 +125,11 @@ def initialize(
         coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
     if process_id is None:
         process_id = _env_int("JAX_PROCESS_ID")
-    if not coordinator_address and (num_processes or 1) <= 1:
+    # Bootstrap-only divergence: this early exit runs BEFORE the
+    # distributed runtime exists, and the launcher sets identical
+    # JAX_* env on every host — single-process mode is a whole-pod
+    # decision, not a per-host one.
+    if not coordinator_address and (num_processes or 1) <= 1:  # repic: noqa[RT401]
         return False  # single process — nothing to do
     try:
         jax.distributed.initialize(

@@ -2802,7 +2802,11 @@ def iter_consensus_chunks(
                         fetches=len(leaves),
                     )
             else:
-                jax.block_until_ready(res.picked)
+                # Intentional barrier: the gang step is not complete
+                # (and retry/quarantine cannot classify a failure)
+                # until the device work has actually finished; every
+                # host blocks here together at the chunk boundary.
+                jax.block_until_ready(res.picked)  # repic: noqa[RT403]
             return res, extras
 
     def _fallback(part):

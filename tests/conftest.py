@@ -46,6 +46,18 @@ import jax
 if not _USE_REAL_TPU:
     jax.config.update("jax_platforms", "cpu")
 
+# Opt-in Pallas differential sanitizer (REPIC_TPU_KERNELCHECK=1):
+# run every @checked kernel entry in interpret mode against its
+# pure-jnp reference across the contract's shape ladder, ONCE at
+# session start.  Divergence is recorded (never raised) and promoted
+# to a red session by the hooks below — the dynamic cross-check of
+# the static RT42x pass (docs/static_analysis.md "KERNELCHECK
+# runbook").  Runs after the jax platform forcing above: the probes
+# execute on the CPU mesh, not a real TPU.
+from repic_tpu.analysis import kernelcheck as _kernelcheck
+
+_kernelcheck.maybe_install_from_env()
+
 import numpy as np
 import pytest
 
@@ -100,16 +112,21 @@ def multiprocess_backend():
 
 
 def pytest_terminal_summary(terminalreporter):
-    if not _lockcheck.installed():
-        return
-    report = _lockcheck.report_text()
-    terminalreporter.section("LOCKCHECK (REPIC_TPU_LOCKCHECK=1)")
-    terminalreporter.write_line(report)
+    if _lockcheck.installed():
+        terminalreporter.section("LOCKCHECK (REPIC_TPU_LOCKCHECK=1)")
+        terminalreporter.write_line(_lockcheck.report_text())
+    if _kernelcheck.installed():
+        terminalreporter.section(
+            "KERNELCHECK (REPIC_TPU_KERNELCHECK=1)"
+        )
+        terminalreporter.write_line(_kernelcheck.report_text())
 
 
 def pytest_sessionfinish(session, exitstatus):
     # A witnessed violation is a red build even if every test passed:
-    # the sanitizer records (never raises) so the failure must be
+    # the sanitizers record (never raise) so the failure must be
     # promoted here, at session scope.
-    if _lockcheck.installed() and _lockcheck.violations():
+    if (_lockcheck.installed() and _lockcheck.violations()) or (
+        _kernelcheck.installed() and _kernelcheck.violations()
+    ):
         session.exitstatus = 1

@@ -647,3 +647,88 @@ def test_decorator_blanket_noqa_suppresses_def_line_finding():
         rules=[_DefAnchored],
     )
     assert findings == []
+
+
+# -- multi-line-call noqa (engine regression) -------------------------
+
+
+class _CallAnchored(Rule):
+    """Test-only rule anchoring one finding at every call's FIRST
+    line — the anchor every real call-site rule uses, which a noqa on
+    the closing-paren line previously failed to reach."""
+
+    rule_id = "RT997"
+    severity = "error"
+    title = "call-anchored test rule"
+    hint = ""
+
+    def check(self, ctx):
+        return [
+            self.finding(ctx, node, "call flagged")
+            for node in ast.walk(ctx.tree)
+            if isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "flagged_call"
+        ]
+
+
+_MULTILINE_CALL = """
+def f(x):
+    return flagged_call(
+        x,
+        mode="full",
+    )
+"""
+
+
+def test_noqa_on_closing_paren_suppresses_multiline_call():
+    lines = _src(_MULTILINE_CALL).splitlines()
+    assert lines[4].strip() == ")"
+    lines[4] += "  # repic: noqa[RT997]"
+    findings = analyze_source(
+        "\n".join(lines) + "\n",
+        "repic_tpu/call.py",
+        rules=[_CallAnchored],
+    )
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_noqa_on_any_continuation_line_suppresses_the_call():
+    lines = _src(_MULTILINE_CALL).splitlines()
+    assert lines[3].strip().startswith("mode=")
+    lines[3] += "  # repic: noqa[RT997]"
+    findings = analyze_source(
+        "\n".join(lines) + "\n",
+        "repic_tpu/call.py",
+        rules=[_CallAnchored],
+    )
+    assert findings == []
+
+
+def test_continuation_noqa_for_other_rule_does_not_suppress():
+    lines = _src(_MULTILINE_CALL).splitlines()
+    lines[4] += "  # repic: noqa[RT001]"
+    findings = analyze_source(
+        "\n".join(lines) + "\n",
+        "repic_tpu/call.py",
+        rules=[_CallAnchored],
+    )
+    assert [f for f in findings if f.rule == "RT997"]
+
+
+def test_continuation_noqa_does_not_leak_to_later_lines():
+    # a noqa INSIDE the call must not suppress findings on lines
+    # after the call ends
+    src = _src(
+        """
+        def f(x):
+            y = flagged_call(
+                x,
+            )  # repic: noqa[RT997]
+            return flagged_call(y)
+        """
+    )
+    findings = analyze_source(
+        src, "repic_tpu/call.py", rules=[_CallAnchored]
+    )
+    assert len([f for f in findings if f.rule == "RT997"]) == 1

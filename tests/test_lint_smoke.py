@@ -202,6 +202,71 @@ def test_unknown_select_is_a_usage_error():
     assert "RT999" in proc.stderr
 
 
+def test_list_rules_covers_the_spmd_and_kernel_packs():
+    proc = _run(["-m", "repic_tpu.analysis", "--list-rules"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    for rule_id in (
+        "RT401", "RT402", "RT403", "RT404",
+        "RT421", "RT422", "RT423", "RT424", "RT425",
+    ):
+        assert rule_id in proc.stdout, rule_id
+
+
+def test_selecting_an_rt40x_rule_enables_the_spmd_pass(tmp_path):
+    # --select RT401 without --spmd must still run the whole-program
+    # pass (a select that silently no-ops reads green)
+    bad = tmp_path / "mod.py"
+    bad.write_text(
+        "import jax\n"
+        "\n"
+        "\n"
+        "def f(x):\n"
+        "    if jax.process_index() == 0:\n"
+        "        x = jax.lax.psum(x, 'i')\n"
+        "    return x\n"
+    )
+    proc = _run(
+        ["-m", "repic_tpu.analysis", str(bad), "--select", "RT401"]
+    )
+    assert proc.returncode == 1, proc.stdout
+    assert "RT401" in proc.stdout
+
+
+def test_lint_help_documents_spmd_mode():
+    proc = _run(["-m", "repic_tpu.main", "lint", "--help"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "--spmd" in proc.stdout
+
+
+def test_spmd_sarif_report_carries_the_rt4xx_rule_table(tmp_path):
+    bad = tmp_path / "mod.py"
+    bad.write_text(
+        "import jax\n"
+        "\n"
+        "\n"
+        "def f(x):\n"
+        "    if jax.process_index() == 0:\n"
+        "        x = jax.lax.psum(x, 'i')\n"
+        "    return x\n"
+    )
+    proc = _run(
+        [
+            "-m", "repic_tpu.analysis", str(bad), "--spmd",
+            "--format", "sarif",
+        ]
+    )
+    assert proc.returncode == 1
+    doc = json.loads(proc.stdout)
+    rules = {
+        r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]
+    }
+    assert {"RT401", "RT402", "RT403", "RT404"} <= rules
+    assert {"RT421", "RT422", "RT423", "RT424", "RT425"} <= rules
+    assert any(
+        r["ruleId"] == "RT401" for r in doc["runs"][0]["results"]
+    )
+
+
 def test_linter_imports_no_jax():
     # JAX startup costs seconds and needs an XLA client; the linter
     # must stay importable and runnable without it (CI lint step).
@@ -211,6 +276,20 @@ def test_linter_imports_no_jax():
         "from repic_tpu.analysis import run_paths\n"
         "run_paths([])\n"
         "assert 'jax' not in sys.modules, 'linter imported jax'\n"
+    )
+    proc = _run(["-c", code])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_spmd_pass_imports_no_jax():
+    # the RT40x pass (and the RT42x plan tables it shares a report
+    # with) must obey the same stdlib-only discipline as lint
+    code = (
+        "import sys\n"
+        "from repic_tpu.analysis.spmd import run_spmd\n"
+        "from repic_tpu.analysis.kernels import KERNEL_RULES\n"
+        "run_spmd([])\n"
+        "assert 'jax' not in sys.modules, 'spmd pass imported jax'\n"
     )
     proc = _run(["-c", code])
     assert proc.returncode == 0, proc.stderr[-2000:]
