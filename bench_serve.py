@@ -126,13 +126,14 @@ def spawn_daemon(wd: str, scheduler: str, max_open: int):
     raise RuntimeError("daemon never wrote _serve.json")
 
 
-def _req(port, method, path, body=None, timeout=300):
+def _req(port, method, path, body=None, timeout=300, headers=None):
     r = urllib.request.Request(
         f"http://127.0.0.1:{port}{path}",
         method=method,
         data=(
             json.dumps(body).encode() if body is not None else None
         ),
+        headers=headers or {},
     )
     try:
         with urllib.request.urlopen(r, timeout=timeout) as resp:
@@ -223,6 +224,208 @@ def bench_one(scheduler, workload, wd, *, clients, rounds,
             proc.communicate()
 
 
+def run_storm(ports, workload, tenants, *, clients, repeat,
+              wait_s, deadline_s=None):
+    """Traffic-storm driver against an EXISTING fleet (the chaos-CI
+    load generator): fire ``repeat`` copies of the workload from
+    ``clients`` threads, round-robin over ``ports`` and the tenant
+    identities, tolerating 429s (that is the point — brownout
+    shedding under pressure), then wait out every accepted job.
+
+    ``tenants`` is ``[(name, key_or_None), ...]``; empty means one
+    keyless identity.  Returns the storm tally row."""
+    if not tenants:
+        tenants = [(None, None)]
+    subs = []
+    i = 0
+    for _ in range(repeat):
+        for in_dir in workload:
+            subs.append((in_dir, tenants[i % len(tenants)]))
+            i += 1
+
+    def one(item):
+        in_dir, (tenant, key) = item
+        headers = (
+            {"Authorization": f"Bearer {key}"} if key else {}
+        )
+        body = {
+            "in_dir": in_dir,
+            "box_size": 180,
+            "options": {"use_mesh": False},
+        }
+        if deadline_s is not None:
+            body["deadline_s"] = deadline_s
+        start = hash(in_dir + str(tenant)) % len(ports)
+        code, resp, port = 0, "", None
+        for k in range(len(ports)):
+            port = ports[(start + k) % len(ports)]
+            try:
+                code, resp = _req(
+                    port, "POST", "/v1/jobs", body,
+                    headers=headers,
+                )
+                break
+            except OSError:
+                continue  # replica died mid-storm: fail over
+        if code == 0:
+            return (tenant, 0, "conn_error", None, None, port,
+                    headers)
+        if code == 202:
+            return (tenant, code, None, None,
+                    json.loads(resp)["id"], port, headers)
+        try:
+            doc = json.loads(resp)
+        except ValueError:
+            doc = {}
+        return (tenant, code, doc.get("error"),
+                doc.get("retry_after_s"), None, port, headers)
+
+    t0 = time.time()
+    with ThreadPoolExecutor(max_workers=clients) as ex:
+        rows = list(ex.map(one, subs))
+    burst_s = time.time() - t0
+
+    by_tenant: dict = {}
+    shed: dict = {}
+    accepted = []
+    for tenant, code, cause, retry_after, jid, port, hdr in rows:
+        name = tenant or "(anonymous)"
+        slot = by_tenant.setdefault(
+            name, {"submitted": 0, "accepted": 0, "shed": {},
+                   "retry_after_s": []}
+        )
+        slot["submitted"] += 1
+        if jid is not None:
+            slot["accepted"] += 1
+            accepted.append((jid, port, hdr, name))
+        else:
+            key = f"{code}:{cause}"
+            slot["shed"][key] = slot["shed"].get(key, 0) + 1
+            shed[key] = shed.get(key, 0) + 1
+            if retry_after is not None:
+                slot["retry_after_s"].append(retry_after)
+
+    # wait out every accepted job (any terminal outcome counts as
+    # resolved; which states occurred is part of the tally)
+    outcomes: dict = {}
+    latencies = []
+    deadline = time.time() + wait_s
+
+    def finish(item):
+        jid, port, headers, name = item
+        k = ports.index(port)
+        while time.time() < deadline:
+            # any fleet replica answers for any job (shared journal
+            # view) — rotate ports so a killed replica cannot strand
+            # the jobs it accepted
+            try:
+                code, body = _req(
+                    ports[k % len(ports)], "GET",
+                    f"/v1/jobs/{jid}", headers=headers, timeout=30,
+                )
+            except OSError:
+                k += 1
+                time.sleep(0.2)
+                continue
+            if code == 200:
+                doc = json.loads(body)
+                if doc["state"] in TERMINAL + ("quarantined",):
+                    lat = (
+                        (doc.get("finished_ts") or time.time())
+                        - doc["accepted_ts"]
+                    )
+                    return name, doc["state"], lat
+            else:
+                k += 1  # 404/5xx: maybe view lag — try a peer
+            time.sleep(0.05)
+        return name, "unresolved", None
+
+    tenant_lats: dict = {}
+    with ThreadPoolExecutor(max_workers=clients) as ex:
+        done = list(ex.map(finish, accepted))
+    for name, state, lat in done:
+        outcomes[state] = outcomes.get(state, 0) + 1
+        by_tenant[name].setdefault("outcomes", {})
+        by_tenant[name]["outcomes"][state] = (
+            by_tenant[name]["outcomes"].get(state, 0) + 1
+        )
+        if lat is not None and state == "finished":
+            latencies.append(lat)
+            tenant_lats.setdefault(name, []).append(lat)
+    for name, slot in by_tenant.items():
+        ra = sorted(slot.pop("retry_after_s"))
+        if ra:
+            slot["retry_after_p50_s"] = ra[len(ra) // 2]
+        lats = sorted(tenant_lats.get(name, ()))
+        if lats:
+            slot["p95_latency_s"] = round(
+                lats[int(0.95 * (len(lats) - 1))], 3
+            )
+    latencies.sort()
+    return {
+        "mode": "storm",
+        "ports": list(ports),
+        "submitted": len(subs),
+        "accepted": len(accepted),
+        "burst_s": round(burst_s, 3),
+        "shed": shed,
+        "outcomes": outcomes,
+        "by_tenant": by_tenant,
+        "p95_latency_s": (
+            round(latencies[int(0.95 * (len(latencies) - 1))], 3)
+            if latencies
+            else None
+        ),
+        "finished": outcomes.get("finished", 0),
+        "unresolved": outcomes.get("unresolved", 0),
+    }
+
+
+def storm_main(args) -> int:
+    """``--storm``: load-generate against an already-running fleet
+    (spawned by ``repic-tpu fleet supervise`` or by hand) instead of
+    spawning daemons; exit 0 iff every accepted job resolved."""
+    if not args.port:
+        print("--storm requires at least one --port", file=sys.stderr)
+        return 2
+    tenants = []
+    for spec in args.tenant or ():
+        name, sep, key = spec.partition("=")
+        tenants.append((name, key if sep else None))
+    scratch = tempfile.mkdtemp(prefix="bench_storm_")
+    try:
+        # small jobs only: a storm is many cheap requests, and the
+        # shedding/deadline story is per-request, not per-micrograph
+        sizes = (1, 2, 1, 2, 1, 2, 1, 2)
+        import numpy as np  # noqa: F401 - fail fast sans numpy
+
+        workload = [
+            d for d in make_workload(scratch, args.particles)
+            if not d.endswith("large")
+        ][: len(sizes)]
+        row = run_storm(
+            args.port, workload, tenants,
+            clients=args.clients, repeat=args.repeat,
+            wait_s=args.wait, deadline_s=args.deadline,
+        )
+        print(json.dumps(row))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(row, f, indent=1)
+        if row["unresolved"]:
+            print(
+                f"FAIL: {row['unresolved']} accepted job(s) never "
+                "reached a terminal state", file=sys.stderr,
+            )
+            return 1
+        return 0
+    finally:
+        if args.keep:
+            print(f"scratch kept at {scratch}", file=sys.stderr)
+        else:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--clients", type=int, default=4)
@@ -233,7 +436,37 @@ def main(argv=None) -> int:
                         help="also write the BENCH row here")
     parser.add_argument("--keep", action="store_true",
                         help="keep the scratch directory")
+    parser.add_argument(
+        "--storm", action="store_true",
+        help="traffic-storm mode: burst against an EXISTING fleet "
+        "(--port, repeatable) instead of spawning daemons; 429s are "
+        "tallied per tenant, not fatal (chaos-CI load generator)",
+    )
+    parser.add_argument(
+        "--port", type=int, action="append", default=None,
+        help="storm target port(s), repeatable (round-robin)",
+    )
+    parser.add_argument(
+        "--tenant", action="append", default=None, metavar="NAME=KEY",
+        help="storm identity, repeatable: submit as this tenant "
+        "(bearer KEY); omit for keyless requests",
+    )
+    parser.add_argument(
+        "--repeat", type=int, default=4,
+        help="storm: copies of the small-job workload to fire "
+        "(default 4)",
+    )
+    parser.add_argument(
+        "--wait", type=float, default=300.0,
+        help="storm: seconds to wait out accepted jobs (default 300)",
+    )
+    parser.add_argument(
+        "--deadline", type=float, default=None,
+        help="storm: per-request deadline_s to submit with",
+    )
     args = parser.parse_args(argv)
+    if args.storm:
+        return storm_main(args)
 
     scratch = tempfile.mkdtemp(prefix="bench_serve_")
     try:

@@ -166,7 +166,11 @@ def add_arguments(parser):
         metavar="FILE",
         help="enable per-tenant auth + quotas from a JSON keyfile "
         '({"tenants": [{"name", "keys", "rate", "burst", '
-        '"max_open_jobs", "max_queued_micrographs"}, ...]}).  '
+        '"max_open_jobs", "max_queued_micrographs", "priority"}, '
+        "...]}).  priority is the brownout shed class "
+        "(high|normal|low, default normal): under error-budget "
+        "pressure the fleet sheds low first, then normal, and "
+        "high-priority admission survives every brownout stage.  "
         "Requests then need 'Authorization: Bearer <key>' (401 "
         "missing, 403 unknown); a tenant literally named "
         "'anonymous' (no keys) admits keyless requests under its "

@@ -35,6 +35,7 @@ COMMANDS = {
     "check": "repic_tpu.analysis.check_cli",
     "report": "repic_tpu.commands.report",
     "serve": "repic_tpu.commands.serve",
+    "fleet": "repic_tpu.commands.fleet",
     "trace": "repic_tpu.commands.trace",
 }
 

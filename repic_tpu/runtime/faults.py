@@ -92,6 +92,21 @@ site              raised at the matching call site
                   re-formation) without waiting out the deadline.
                   Keys: ``<host>:gchunk:<epoch>:<i>`` /
                   ``<host>:exchange``
+``scale_stall``   no exception — polled by the fleet supervisor
+                  (``serve.autoscale.Supervisor.tick``) before it
+                  acts on a scale decision; a firing wedges that
+                  tick (the decision is journaled as ``stalled``
+                  and NOT acted on), the deterministic stand-in
+                  for a wedged controller — the fleet must keep
+                  serving at its current size.  Key: the tick
+                  index (``tick:<n>``)
+``storm``         no exception — polled where the fleet supervisor
+                  samples its signals; a firing substitutes
+                  saturated synthetic signals (maximal budget burn
+                  + a deep queue), the deterministic traffic-storm
+                  stand-in that drives scale-up and brownout
+                  without having to race real load.  Key: the tick
+                  index (``tick:<n>``)
 ``poison_job``    no exception — polled by
                   ``serve.jobs.poison_point`` right after the
                   worker binds a job to its input; a firing
@@ -148,6 +163,8 @@ KNOWN_SITES = (
     "gang_peer_crash",
     "gang_peer_stall",
     "coordinator_loss",
+    "scale_stall",
+    "storm",
 )
 
 

@@ -161,6 +161,13 @@ class ContinuousBatcher:
     #: compiled shape (bucket_key must not fragment the program
     #: cache across jobs differing only in micrograph count)
     MIN_CHUNK_PAD = 4
+    #: ``job`` budget-burn rate at or above which dealing switches
+    #: from round-robin to earliest-deadline-first — burn 1.0 is the
+    #: break-even point where the error budget is spending exactly
+    #: as fast as it accrues, so any sustained excess means jobs are
+    #: already missing the latency objective and ordering by slack
+    #: beats ordering by arrival
+    EDF_BURN = 1.0
 
     def __init__(self, daemon, max_open: int = 4):
         if max_open < 1:
@@ -173,6 +180,7 @@ class ContinuousBatcher:
         self._last_capacity: int | None = None
         self._streak = 0
         self._rr = -1  # first deal starts at the oldest open job
+        self._dealing = "round_robin"  # last _select ordering mode
 
     # -- the loop -----------------------------------------------------
 
@@ -211,6 +219,7 @@ class ContinuousBatcher:
                 len(oj.pending) for oj in self._open
             ),
             "warm_capacity": self._last_capacity,
+            "dealing": self._dealing,
         }
 
     # -- admission into the open set ----------------------------------
@@ -501,10 +510,32 @@ class ContinuousBatcher:
         # pick, keyed by TENANT above the per-job rotation — a burst
         # of small jobs rides along with a large one, and one noisy
         # tenant's many open jobs cannot crowd a quiet tenant's one
-        # job out of the chunk (each tenant gets one slot per round)
-        self._rr += 1
-        start = self._rr % len(jobs)
-        order = jobs[start:] + jobs[:start]
+        # job out of the chunk (each tenant gets one slot per round).
+        # When the error budget is burning (or the fleet is in
+        # brownout) the FIRST PICK stops rotating and goes earliest-
+        # deadline-first instead: under pressure the leftover slots
+        # of an uneven deal belong to the jobs closest to blowing
+        # their deadline, not to whoever arrival order favors.  The
+        # per-tenant one-slot-per-round deal is unchanged, so EDF
+        # reorders urgency WITHIN fairness bounds rather than letting
+        # one tight-deadline tenant starve the rest.
+        if self._edf_active():
+            self._dealing = "edf"
+            order = sorted(
+                jobs,
+                key=lambda oj: (
+                    oj.job.deadline_ts is None,
+                    oj.job.deadline_ts
+                    if oj.job.deadline_ts is not None
+                    else 0.0,
+                    oj.job.accepted_ts,
+                ),
+            )
+        else:
+            self._dealing = "round_robin"
+            self._rr += 1
+            start = self._rr % len(jobs)
+            order = jobs[start:] + jobs[:start]
         alloc = self._deal(order, target)
         parts = []
         for oj in order:
@@ -551,6 +582,23 @@ class ContinuousBatcher:
             if not progressed:
                 break
         return alloc
+
+    def _edf_active(self) -> bool:
+        """Deadline-first dealing engages while the ``job`` error
+        budget burns at or above :data:`EDF_BURN`, or while the
+        fleet is in any brownout stage (the autoscaler has already
+        judged the budget tight — admission is shedding, so what IS
+        admitted should finish by deadline).  Either signal absent
+        (no tracker, no objective, no supervisor) reads as calm."""
+        slo = getattr(getattr(self, "daemon", None), "slo", None)
+        if slo is not None:
+            burn = slo.budget_burn("job")
+            if burn is not None and burn >= self.EDF_BURN:
+                return True
+        brownout = getattr(
+            getattr(self, "queue", None), "_brownout", None
+        )
+        return brownout is not None and brownout.level() >= 1
 
     def _ladder_around(self, m: int) -> tuple:
         """The chunk-shape ladder values bracketing ``m``: powers of

@@ -37,6 +37,7 @@ import time
 from repic_tpu import telemetry
 from repic_tpu.runtime import faults
 from repic_tpu.runtime.atomic import atomic_write
+from repic_tpu.serve import autoscale
 from repic_tpu.serve import jobs as jobs_mod
 from repic_tpu.serve import tenancy
 from repic_tpu.serve.jobs import (
@@ -573,6 +574,15 @@ class ConsensusDaemon:
             pid=os.getpid(),
             port=self.server.port,
             recovered=[j.id for j in recovered],
+            # journal the objectives too: `repic-tpu report` rebuilds
+            # SLO compliance from the journal post-mortem, and the
+            # targets it judges against must be the ones this run
+            # actually served under, not whatever the CLI defaults
+            # to at report time
+            slo_targets={
+                ep: [t, g]
+                for ep, (t, g) in sorted(self.slo.objectives.items())
+            },
         )
         if self.fleet is None:
             runnable = []
@@ -733,6 +743,13 @@ class ConsensusDaemon:
         )
         if self.fleet is not None:
             fields["fleet"] = self.queue.fleet_status()
+            # surface the supervisor's last published posture (if
+            # one is running over this fleet_dir) so any replica's
+            # /status answers "what is the autoscaler doing and
+            # why" without finding the supervisor process
+            scale = autoscale.read_state(self.fleet.fleet_dir)
+            if scale is not None:
+                fields["autoscaler"] = scale
         if self.tenancy is not None:
             fields["tenants"] = self._tenant_status()
         tlm_server.set_status(**fields)

@@ -73,7 +73,7 @@ from repic_tpu.runtime.journal import (
     sanitize_host_id,
 )
 from repic_tpu.runtime.ladder import HOST_LIVE
-from repic_tpu.serve import tenancy
+from repic_tpu.serve import autoscale, tenancy
 from repic_tpu.serve.jobs import (
     DEFAULT_REASSIGN_BUDGET,
     JOB_CANCELLED,
@@ -577,6 +577,10 @@ class FleetQueue:
         self.draining = False
         # decayed per-micrograph service time (Retry-After unit)
         self._avg_mic_s = 2.0
+        # fleet supervisor posture (fleet_dir/_autoscale_state.json):
+        # EVERY replica reads the same file, so brownout shedding is
+        # fleet-uniform the moment the supervisor publishes it
+        self._brownout = autoscale.BrownoutReader(member.fleet_dir)
         self._reader = MergedJournalReader(
             member.fleet_dir, base_name=SERVE_JOURNAL_NAME
         )
@@ -784,8 +788,17 @@ class FleetQueue:
         view = self.fleet_view()
         depth = self._fleet_depth(view)
         live = self.member.live_replicas()
+        # brownout shedding FIRST (ahead of the depth check): staged
+        # degradation refuses low-priority work before the queue is
+        # full — bending, not cliffing (docs/serving.md)
+        state = self._brownout.state()
+        level = self._brownout.level()
+        shed = autoscale.shed_priorities(level)
+        if shed and self._priority_of(tenant) in shed:
+            self._reject_brownout(tenant, state, shed, view, live)
         stormed = faults.check("request_storm", "submit")
-        if depth >= self.limit or stormed:
+        limit = autoscale.effective_queue_limit(self.limit, level)
+        if depth >= limit or stormed:
             _REJECTED.inc(reason="queue_full")
             _ADMISSION.inc(
                 outcome="rejected", cause="queue_full", code="429"
@@ -904,6 +917,56 @@ class FleetQueue:
             tenancy.note_admitted(tenant)
         serve_crash_point(f"accept:{job.id}")
         return job, False
+
+    def _priority_of(self, tenant: str | None) -> str:
+        if self.tenants is None:
+            return tenancy.DEFAULT_PRIORITY
+        return self.tenants.priority(tenant)
+
+    def _unshed_micrographs(self, view: dict, shed: tuple) -> int:
+        """Fleet-wide queued micrographs of classes still admitted
+        — the backlog that drains ahead of a shed tenant."""
+        total = 0
+        for jid, info in view.items():
+            if (
+                info["state"] != JOB_QUEUED
+                or not self._is_open(jid, info)
+                or self.member.lease_info(jid) is not None
+            ):
+                continue
+            if self._priority_of(
+                info["first"].get("tenant")
+            ) not in shed:
+                total += info["first"].get("micrographs") or 1
+        return total
+
+    def _reject_brownout(
+        self,
+        tenant: str | None,
+        state: dict | None,
+        shed: tuple,
+        view: dict,
+        live: int,
+    ):
+        """The fleet brownout 429, priced from the shed class's
+        un-shed horizon: supervisor interval + remaining cooldown +
+        the admitted classes' fleet-wide drain time spread over the
+        LIVE replicas — not the global per-micrograph estimate."""
+        from repic_tpu.serve.jobs import _ADMISSION, _REJECTED
+
+        retry_after = autoscale.shed_horizon_s(
+            state,
+            self._unshed_micrographs(view, shed),
+            self._avg_mic_s,
+            live=live,
+        )
+        _REJECTED.inc(reason="brownout")
+        _ADMISSION.inc(
+            outcome="rejected", cause="brownout", code="429"
+        )
+        if tenant is not None:
+            tenancy.note_rejected(tenant, "brownout")
+        raise AdmissionError(429, "brownout", retry_after)
 
     def _tenant_view_tallies(
         self, view: dict, tenant: str

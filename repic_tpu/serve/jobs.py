@@ -26,7 +26,7 @@ from dataclasses import dataclass, field
 from repic_tpu import telemetry
 from repic_tpu.runtime import faults
 from repic_tpu.runtime.journal import _read_entries, error_info
-from repic_tpu.serve import tenancy
+from repic_tpu.serve import autoscale, tenancy
 from repic_tpu.telemetry import server as tlm_server
 from repic_tpu.telemetry import trace as tlm_trace
 
@@ -719,6 +719,10 @@ class JobQueue:
         # batching, where many small jobs clear in one coalesced
         # chunk (docs/serving.md "Overload")
         self._avg_mic_s = 2.0
+        # brownout posture published by the fleet supervisor into
+        # the queue's root directory (mtime-cached stat per submit;
+        # no file -> level 0, today's behavior bit for bit)
+        self._brownout = autoscale.BrownoutReader(journal.work_dir)
 
     # -- admission ----------------------------------------------------
 
@@ -804,9 +808,21 @@ class JobQueue:
                 if job is not None:
                     _DEDUPED.inc()
                     return job, True
+            # brownout shedding FIRST (ahead of the depth check):
+            # staged degradation must refuse low-priority work
+            # before the queue is full, not after — that is the
+            # whole point of bending instead of cliffing
+            state = self._brownout.state()
+            level = self._brownout.level()
+            shed = autoscale.shed_priorities(level)
+            if shed and self._priority_of(tenant) in shed:
+                self._reject_brownout(tenant, state, shed)
             backlog = len(self._pending) + len(self._running)
             stormed = faults.check("request_storm", "submit")
-            if backlog >= self.limit or stormed:
+            limit = autoscale.effective_queue_limit(
+                self.limit, level
+            )
+            if backlog >= limit or stormed:
                 _REJECTED.inc(reason="queue_full")
                 _ADMISSION.inc(
                     outcome="rejected", cause="queue_full",
@@ -899,6 +915,52 @@ class JobQueue:
         crash_point(f"accept:{job.id}")
         self._wake.set()
         return job, False
+
+    def _priority_of(self, tenant: str | None) -> str:
+        """The submitting tenant's brownout class — ``normal`` with
+        tenancy off, so shedding still stages for an open daemon."""
+        if self.tenants is None:
+            return tenancy.DEFAULT_PRIORITY
+        return self.tenants.priority(tenant)
+
+    def _unshed_micrographs_locked(self, shed: tuple) -> int:
+        """Queued micrographs belonging to classes still admitted —
+        the backlog that drains AHEAD of a shed tenant (the honest
+        half of its Retry-After).  Lock held."""
+        total = 0
+        for jid in self._pending:
+            j = self._jobs.get(jid)
+            if j is None:
+                continue
+            if self._priority_of(j.tenant) not in shed:
+                total += j.micrographs or 1
+        return total
+
+    def _reject_brownout(
+        self,
+        tenant: str | None,
+        state: dict | None,
+        shed: tuple,
+        live: int = 1,
+    ):
+        """Raise the brownout 429, priced from the shed class's
+        expected un-shed horizon (supervisor interval + remaining
+        cooldown + admitted-classes drain), NOT the global
+        per-micrograph estimate — which under-advises in a storm
+        (docs/serving.md "Autoscaling & brownout").  Lock held."""
+        retry_after = autoscale.shed_horizon_s(
+            state,
+            self._unshed_micrographs_locked(shed),
+            self._avg_mic_s,
+            live=live,
+        )
+        _REJECTED.inc(reason="brownout")
+        _ADMISSION.inc(
+            outcome="rejected", cause="brownout", code="429"
+        )
+        if tenant is not None:
+            tenancy.note_rejected(tenant, "brownout")
+        raise AdmissionError(429, "brownout", retry_after)
 
     def _tenant_tallies_locked(self, tenant: str) -> tuple[int, int]:
         """(open jobs, queued micrographs) for one tenant — call

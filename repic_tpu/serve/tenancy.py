@@ -55,6 +55,14 @@ from repic_tpu import telemetry
 
 TENANT_ANONYMOUS = "anonymous"
 
+#: brownout priority classes, best-kept-first: under staged load
+#: shedding (docs/serving.md "Autoscaling & brownout") ``low`` is
+#: refused admission first, then ``normal``; ``high`` is never shed
+#: at admission.  Tenants without a declared class — and requests
+#: with no tenant at all — are ``normal``.
+PRIORITIES = ("high", "normal", "low")
+DEFAULT_PRIORITY = "normal"
+
 #: tenant names become metric label values, SLO endpoint names, and
 #: journal fields — one restricted alphabet, like journal host ids
 _NAME_RE = re.compile(r"^[A-Za-z0-9._-]{1,64}$")
@@ -148,6 +156,7 @@ class TenantSpec:
     burst: int = 1                     # bucket capacity
     max_open_jobs: int | None = None
     max_queued_micrographs: int | None = None
+    priority: str = DEFAULT_PRIORITY   # brownout shed class
 
 
 def _require(cond: bool, msg: str) -> None:
@@ -163,7 +172,7 @@ def _parse_spec(entry: object, index: int) -> TenantSpec:
     )
     known = {
         "name", "keys", "rate", "burst", "max_open_jobs",
-        "max_queued_micrographs",
+        "max_queued_micrographs", "priority",
     }
     unknown = sorted(str(k)[:80] for k in set(entry) - known)
     _require(
@@ -228,11 +237,18 @@ def _parse_spec(entry: object, index: int) -> TenantSpec:
                 f"tenant {name!r}: {cap} must be an int >= 1",
             )
         caps[cap] = v
+    priority = entry.get("priority", DEFAULT_PRIORITY)
+    _require(
+        priority in PRIORITIES,
+        f"tenant {name!r}: priority must be one of "
+        f"{list(PRIORITIES)}, got {str(priority)[:80]!r}",
+    )
     return TenantSpec(
         name=name,
         keys=tuple(keys),
         rate=rate,
         burst=burst,
+        priority=priority,
         **caps,
     )
 
@@ -377,6 +393,14 @@ class TenantRegistry:
     def spec(self, name: str) -> TenantSpec | None:
         return self._specs.get(name)
 
+    def priority(self, name: str | None) -> str:
+        """The brownout class of ``name`` — ``normal`` for no tenant
+        (tenancy off / pre-tenancy jobs) and for unknown names, so
+        shedding composes with every identity configuration."""
+        spec = self._specs.get(name) if name is not None else None
+        return spec.priority if spec is not None \
+            else DEFAULT_PRIORITY
+
     # -- identity -----------------------------------------------------
 
     def resolve(self, authorization: str | None) -> str:
@@ -483,7 +507,7 @@ class TenantRegistry:
         """The /status view of one tenant's configured limits and
         live rate state (never the keys)."""
         spec = self._specs[name]
-        out: dict = {}
+        out: dict = {"priority": spec.priority}
         if spec.rate is not None:
             with self._lock:
                 b = self._buckets[name]

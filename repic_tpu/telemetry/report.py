@@ -93,6 +93,151 @@ def _read_runtime_tsv(run_dir: str) -> dict:
     return out
 
 
+# serve-journal vocabulary, duplicated from repic_tpu.serve.jobs so
+# the report stays importable without the serving stack (and without
+# a telemetry -> serve dependency edge)
+_SERVE_JOURNAL_NAME = "_serve_journal.jsonl"
+_SERVE_OK_STATE = "finished"
+_SERVE_TERMINAL = frozenset(
+    ("finished", "failed", "cancelled", "deadline_exceeded",
+     "quarantined")
+)
+
+
+def _slo_window_gauges(metrics_by_host: dict) -> dict:
+    """Per-endpoint rolling-window SLO numbers from the
+    ``repic_slo_*`` gauges of any ``_metrics.json`` snapshot.  These
+    are labeled gauges (one sample per endpoint), so the flat
+    :func:`_gauge_value` cannot read them; with several snapshots
+    (fleet replicas) the one that saw the most observations wins per
+    endpoint."""
+    best: dict[str, dict] = {}
+    for m in metrics_by_host.values():
+        if not isinstance(m, dict):
+            continue
+
+        def by_endpoint(gauge_name: str) -> dict:
+            entry = m.get(gauge_name) or {}
+            out = {}
+            for sample in entry.get("samples", []):
+                ep = (sample.get("labels") or {}).get("endpoint")
+                if ep is not None:
+                    out[ep] = sample.get("value")
+            return out
+
+        counts = by_endpoint("repic_slo_window_count")
+        p95 = by_endpoint("repic_slo_p95_seconds")
+        compliance = by_endpoint("repic_slo_compliance")
+        burn = by_endpoint("repic_slo_budget_burn")
+        for ep, count in counts.items():
+            row: dict = {"count": int(count)}
+            if ep in p95:
+                row["p95_s"] = p95[ep]
+            if ep in compliance:
+                row["compliance"] = compliance[ep]
+            if ep in burn:
+                row["budget_burn"] = burn[ep]
+            prev = best.get(ep)
+            if prev is None or row["count"] >= prev["count"]:
+                best[ep] = row
+    return {ep: best[ep] for ep in sorted(best)}
+
+
+def _slo_section(run_dir: str, metrics_by_host: dict):
+    """Post-mortem SLO reconstruction (docs/serving.md): per-endpoint
+    compliance and error-budget burn rebuilt from the serve request
+    journal(s) — accept-to-terminal latency per job, judged against
+    the objectives the daemon journaled at startup — plus the live
+    tracker's last rolling-window gauges where a metrics snapshot
+    carries them.  The journal view covers the WHOLE run (the /status
+    window is bounded), and needs no live daemon: this is what an
+    incident review reads after the fleet is gone.  ``None`` when the
+    directory holds no serve artifacts at all."""
+    from repic_tpu.runtime.journal import MergedJournalReader
+
+    entries = MergedJournalReader(
+        run_dir, base_name=_SERVE_JOURNAL_NAME
+    ).entries()
+    objectives: dict = {}
+    jobs: dict[str, dict] = {}
+    for e in entries:
+        if e.get("event") == "server_started":
+            # last generation wins: judge against the objectives the
+            # run actually served under at the end
+            targets = e.get("slo_targets")
+            if isinstance(targets, dict):
+                try:
+                    objectives = {
+                        str(ep): (float(t), float(g))
+                        for ep, (t, g) in targets.items()
+                    }
+                except (TypeError, ValueError):
+                    pass
+            continue
+        jid = e.get("job")
+        state = e.get("state")
+        if jid is None or state is None:
+            continue
+        row = jobs.setdefault(jid, {})
+        if state == "queued":
+            if "accepted" not in row:
+                row["accepted"] = e.get("ts")
+                if e.get("tenant") is not None:
+                    row["tenant"] = e["tenant"]
+        elif state in _SERVE_TERMINAL and "done" not in row:
+            row["done"] = e.get("ts")
+            row["state"] = state
+    rows: dict[str, list] = {}
+    for row in jobs.values():
+        accepted, done = row.get("accepted"), row.get("done")
+        if accepted is None or done is None:
+            continue
+        lat = max(float(done) - float(accepted), 0.0)
+        ok = row.get("state") == _SERVE_OK_STATE
+        rows.setdefault("job", []).append((lat, ok))
+        if row.get("tenant") is not None:
+            rows.setdefault(
+                f"tenant:{row['tenant']}", []
+            ).append((lat, ok))
+    endpoints: dict = {}
+    for ep in sorted(rows):
+        lats = [lat for lat, _ in rows[ep]]
+        entry = {
+            "count": len(lats),
+            "p50_s": round(_percentile(lats, 0.50), 6),
+            "p95_s": round(_percentile(lats, 0.95), 6),
+        }
+        objective = objectives.get(ep)
+        if objective is None and ep.startswith("tenant:"):
+            # the same inheritance the live tracker applies
+            objective = objectives.get("job")
+        if objective is not None:
+            target, goal = objective
+            bad = sum(
+                1 for lat, ok in rows[ep] if not ok or lat > target
+            )
+            violating = bad / len(rows[ep])
+            entry["target_s"] = target
+            entry["goal"] = goal
+            entry["compliance"] = round(1.0 - violating, 4)
+            entry["budget_burn"] = round(
+                violating / max(1.0 - goal, 1e-9), 3
+            )
+        endpoints[ep] = entry
+    window = _slo_window_gauges(metrics_by_host)
+    if not endpoints and not window:
+        return None
+    section: dict = {"endpoints": endpoints}
+    if objectives:
+        section["objectives"] = {
+            ep: {"target_s": t, "goal": g}
+            for ep, (t, g) in sorted(objectives.items())
+        }
+    if window:
+        section["window"] = window
+    return section
+
+
 def build_report(run_dir: str) -> dict:
     """Join journal + events + metrics of ``run_dir`` into one dict.
 
@@ -318,6 +463,10 @@ def build_report(run_dir: str) -> dict:
             "count": len(traces),
             "traces": traces,
         }
+    # -- SLO post-mortem (serve journal + repic_slo_* gauges) --------
+    slo = _slo_section(run_dir, metrics_by_host)
+    if slo is not None:
+        report["slo"] = slo
     if clustered:
         cluster["hosts"] = dict(sorted(cluster["hosts"].items()))
         cluster["suspects"] = len(suspect_hosts)
@@ -537,6 +686,37 @@ def format_report(report: dict) -> str:
         lines.append(
             "  (waterfall + critical path: repic-tpu trace <dir>)"
         )
+
+    slo = report.get("slo")
+    if slo:
+        if slo.get("endpoints"):
+            lines.append("slo (journal, accept -> terminal):")
+            for ep, st in slo["endpoints"].items():
+                base = (
+                    f"  {ep}: n={st['count']} "
+                    f"p50={st['p50_s']:.3f}s p95={st['p95_s']:.3f}s"
+                )
+                if "budget_burn" in st:
+                    base += (
+                        f" compliance={st['compliance']:.4f}"
+                        f" burn={st['budget_burn']:.2f}"
+                        f" (target {st['target_s']:g}s"
+                        f"@{st['goal']:g})"
+                    )
+                lines.append(base)
+        win = slo.get("window")
+        if win:
+            lines.append("slo (last rolling window, gauges):")
+            for ep, st in win.items():
+                base = f"  {ep}: n={st['count']}"
+                if "p95_s" in st:
+                    base += f" p95={st['p95_s']:.3f}s"
+                if "budget_burn" in st:
+                    base += (
+                        f" compliance={st.get('compliance', 0):.4f}"
+                        f" burn={st['budget_burn']:.2f}"
+                    )
+                lines.append(base)
 
     if report["runtime_tsv"]:
         stages = " ".join(

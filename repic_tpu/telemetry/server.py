@@ -63,6 +63,28 @@ _HTTP_SECONDS = _metrics.histogram(
 #: hard cap on any request body this server will buffer (413 above)
 MAX_REQUEST_BODY = 4 << 20
 
+# Durable SLO surface: the rolling tracker's per-endpoint view,
+# exported as registry gauges so the end-of-run ``_metrics.json``
+# snapshot (and any /metrics scrape) carries compliance + burn —
+# what ``repic-tpu report``'s slo section reconstructs post-mortem
+# without a live /status (docs/serving.md).
+_SLO_COMPLIANCE = _metrics.gauge(
+    "repic_slo_compliance",
+    "rolling SLO compliance fraction (by endpoint)",
+)
+_SLO_BURN = _metrics.gauge(
+    "repic_slo_budget_burn",
+    "rolling error-budget burn rate (by endpoint)",
+)
+_SLO_P95 = _metrics.gauge(
+    "repic_slo_p95_seconds",
+    "rolling p95 latency over the SLO window (by endpoint)",
+)
+_SLO_COUNT = _metrics.gauge(
+    "repic_slo_window_count",
+    "observations in the rolling SLO window (by endpoint)",
+)
+
 
 # -- SLO tracking ------------------------------------------------------
 
@@ -194,6 +216,19 @@ class SLOTracker:
                     for b, rows in sorted(slot["buckets"].items())
                 }
             endpoints[endpoint] = entry
+        # mirror the rolling view onto the durable gauges: the
+        # end-of-run _metrics.json (and any /metrics scrape) then
+        # carries the same numbers /status shows live
+        for endpoint, entry in endpoints.items():
+            _SLO_P95.set(entry["p95_s"], endpoint=endpoint)
+            _SLO_COUNT.set(entry["count"], endpoint=endpoint)
+            if "budget_burn" in entry:
+                _SLO_COMPLIANCE.set(
+                    entry["compliance"], endpoint=endpoint
+                )
+                _SLO_BURN.set(
+                    entry["budget_burn"], endpoint=endpoint
+                )
         return {
             "window": self.window,
             "objectives": {
@@ -202,6 +237,36 @@ class SLOTracker:
             },
             "endpoints": endpoints,
         }
+
+    def objective_for(self, endpoint: str):
+        """The endpoint's objective, with ``tenant:*`` inheriting
+        the ``job`` target (the same rule :meth:`summary` applies)."""
+        objective = self.objectives.get(endpoint)
+        if objective is None and endpoint.startswith("tenant:"):
+            objective = self.objectives.get("job")
+        return objective
+
+    def budget_burn(self, endpoint: str) -> float | None:
+        """The endpoint's current burn rate alone — the autoscaler's
+        and the batcher's control signal, cheap enough to poll every
+        scheduling pass (one pass over the rolling window, no
+        percentile sorts).  ``None`` without an objective or before
+        any observation."""
+        objective = self.objective_for(endpoint)
+        if objective is None:
+            return None
+        target, goal = objective
+        with self._lock:
+            rows = [
+                row
+                for (ep, _bucket), dq in self._samples.items()
+                if ep == endpoint
+                for row in dq
+            ]
+        if not rows:
+            return None
+        bad = sum(1 for lat, ok in rows if not ok or lat > target)
+        return (bad / len(rows)) / max(1.0 - goal, 1e-9)
 
 
 def set_slo_tracker(tracker: "SLOTracker | None") -> "SLOTracker | None":

@@ -1,0 +1,313 @@
+#!/usr/bin/env python3
+"""Traffic-storm chaos gate: storm + replica SIGKILL under the
+supervisor, zero operator action.
+
+The ISSUE 17 acceptance run, deterministic end to end:
+
+1. A :class:`repic_tpu.serve.autoscale.Supervisor` runs IN PROCESS
+   (real ``serve --fleet-dir`` replica spawns, fast control ticks)
+   over a fresh fleet dir with three priority classes (gold=high,
+   std=normal, bulk=low).
+2. Once the first replica serves, the ``storm`` fault site is armed
+   in-process: the supervisor's signal sampling saturates (maximal
+   burn + deep queue) for a bounded window — the deterministic
+   traffic storm.  Meanwhile ``bench_serve.py --storm`` fires a real
+   request burst across all three tenants.
+3. Mid-storm, one managed replica is SIGKILLed.
+4. The plan is cleared; the fleet must recover on its own.
+
+Asserted (exit 1 on any failure, the CI gate):
+
+* the supervisor journaled >= 1 scale-up WITH its triggering
+  signals, and the brownout posture reached the shedding stages;
+* the SIGKILLed replica was reaped (``replica_exit``) and replaced
+  (``replica_spawned``) with no operator action;
+* the high-priority tenant was never brownout-shed, and every job it
+  got accepted finished within the SLO target at p95;
+* low-priority shedding actually engaged (brownout 429s with a
+  Retry-After) OR the storm window closed before the burst — the
+  tally is printed either way;
+* every accepted job reached a terminal state (nothing lost).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/chaos_storm.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from repic_tpu.runtime import faults  # noqa: E402
+from repic_tpu.serve import autoscale  # noqa: E402
+
+# generous enough to absorb a cold CPU compile (no warmup, compile
+# cache off) — the gate is about *keeping* the target under chaos,
+# not about raw speed
+SLO_TARGET_S = 120.0
+SLO_GOAL = 0.9
+
+TENANTS = {
+    "tenants": [
+        {"name": "gold", "keys": ["chaos-kg"], "priority": "high"},
+        {"name": "std", "keys": ["chaos-ks"]},
+        {"name": "bulk", "keys": ["chaos-kb"], "priority": "low"},
+    ]
+}
+
+
+def fail(msg: str) -> None:
+    print(f"CHAOS-STORM FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def wait_for(pred, timeout_s, what):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.2)
+    fail(f"timed out after {timeout_s}s waiting for {what}")
+
+
+def replica_docs(work_root):
+    out = {}
+    if not os.path.isdir(work_root):
+        return out
+    for name in os.listdir(work_root):
+        p = os.path.join(work_root, name, "_serve.json")
+        try:
+            with open(p) as f:
+                out[name] = json.load(f)
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def ready_ports(work_root):
+    """Ports of replicas answering /healthz/ready with 200."""
+    import urllib.request
+
+    ports = []
+    for doc in replica_docs(work_root).values():
+        port = doc.get("port")
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz/ready",
+                timeout=1.0,
+            ) as resp:
+                if resp.status == 200:
+                    ports.append(port)
+        except OSError:
+            continue
+    return sorted(ports)
+
+
+def main() -> int:
+    scratch = tempfile.mkdtemp(prefix="chaos_storm_")
+    fleet_dir = os.path.join(scratch, "fleet")
+    keyfile = os.path.join(scratch, "tenants.json")
+    with open(keyfile, "w") as f:
+        json.dump(TENANTS, f)
+
+    sup = autoscale.Supervisor(
+        fleet_dir,
+        min_replicas=1,
+        max_replicas=2,
+        interval_s=0.5,
+        cooldown_s=2.0,
+        serve_args=(
+            "--no-warmup",
+            "--queue-limit", "64",
+            "--compile-cache", "off",
+            "--tenants", keyfile,
+            "--slo-target", f"job={SLO_TARGET_S:g}@{SLO_GOAL:g}",
+        ),
+    )
+    thread = threading.Thread(target=sup.run, daemon=True)
+    thread.start()
+    try:
+        work_root = sup.work_root
+        print("waiting for first replica...", file=sys.stderr)
+        wait_for(
+            lambda: ready_ports(work_root), 180,
+            "a ready replica",
+        )
+
+        # -- storm window: saturate the supervisor's signals for ~20
+        #    ticks (10 s) while a real burst hits the fleet ---------
+        faults.install("storm:tick:20")
+        wait_for(
+            lambda: (autoscale.read_state(fleet_dir) or {}).get(
+                "level", 0
+            ) >= 2,
+            30, "brownout shedding stage",
+        )
+        # the storm scale-up target is 2; burst only once both are
+        # answering so the client has a surviving port after the kill
+        ports = wait_for(
+            lambda: (
+                p if len(p := ready_ports(work_root)) >= 2 else None
+            ),
+            120, "two ready replicas",
+        )
+        print(f"storm armed; bursting at ports {ports}",
+              file=sys.stderr)
+        storm_out = os.path.join(scratch, "storm.json")
+        bench = subprocess.Popen(
+            [
+                sys.executable,
+                os.path.join(REPO, "bench_serve.py"),
+                "--storm",
+                *[a for p in ports for a in ("--port", str(p))],
+                "--tenant", "gold=chaos-kg",
+                "--tenant", "std=chaos-ks",
+                "--tenant", "bulk=chaos-kb",
+                "--repeat", "3",
+                "--particles", "60",
+                "--clients", "8",
+                "--wait", "240",
+                "--out", storm_out,
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+
+        # -- SIGKILL one managed replica mid-storm ------------------
+        time.sleep(1.0)
+        victim_name, victim_pid = wait_for(
+            lambda: next(
+                (
+                    (name, doc["pid"])
+                    for name, doc in replica_docs(work_root).items()
+                    if name in sup.managed and doc.get("pid")
+                ),
+                None,
+            ),
+            60, "a managed replica with a pid",
+        )
+        print(f"SIGKILL replica {victim_name} (pid {victim_pid})",
+              file=sys.stderr)
+        os.kill(victim_pid, signal.SIGKILL)
+        wait_for(
+            lambda: any(
+                d.get("ev") == "replica_exit"
+                and d.get("replica") == victim_name
+                for d in autoscale.read_decisions(fleet_dir)
+            ),
+            60, "the SIGKILLed replica to be reaped",
+        )
+        wait_for(
+            lambda: len(
+                [
+                    d
+                    for d in autoscale.read_decisions(fleet_dir)
+                    if d.get("ev") == "replica_spawned"
+                ]
+            ) >= 3,  # min spawn + storm scale-up + replacement
+            60, "a replacement replica spawn",
+        )
+
+        bench_log = bench.communicate(timeout=400)[0]
+        print(bench_log, file=sys.stderr)
+        if bench.returncode != 0:
+            fail(f"storm burst rc {bench.returncode}")
+        with open(storm_out) as f:
+            storm = json.load(f)
+
+        # storm fault exhausted by now; the fleet must settle on its
+        # own — queue drained, no leases, posture published
+        faults.clear()
+        wait_for(
+            lambda: (autoscale.read_state(fleet_dir) or {}).get(
+                "leases", 1
+            ) == 0
+            and (autoscale.read_state(fleet_dir) or {}).get(
+                "depth", 1
+            ) == 0,
+            120, "the fleet to drain after the storm",
+        )
+    finally:
+        sup.request_stop()
+        thread.join(timeout=180)
+
+    # -- assertions -----------------------------------------------------
+    decisions = autoscale.read_decisions(fleet_dir)
+    scale_ups = [
+        d for d in decisions
+        if d.get("ev") == "scale" and d.get("action") == "up"
+    ]
+    if not scale_ups:
+        fail("no scale-up decision journaled")
+    for d in scale_ups:
+        if "signals" not in d or "burn" not in d["signals"]:
+            fail(f"scale decision without signals: {d}")
+    if not any(d.get("storm") for d in scale_ups):
+        fail("storm window never drove a scale-up")
+    levels = [
+        d.get("level", 0) for d in decisions if d.get("ev") == "scale"
+    ]
+    if max(levels, default=0) < 2:
+        fail("brownout never reached a shedding stage")
+
+    gold = storm["by_tenant"].get("gold") or {}
+    gold_shed = {
+        k: v for k, v in (gold.get("shed") or {}).items()
+        if "brownout" in k
+    }
+    if gold_shed:
+        fail(f"high-priority tenant was brownout-shed: {gold_shed}")
+    if storm.get("unresolved"):
+        fail(f"{storm['unresolved']} accepted job(s) lost")
+    gold_outcomes = gold.get("outcomes") or {}
+    if gold.get("accepted") and gold_outcomes.get(
+        "finished", 0
+    ) < gold["accepted"]:
+        fail(f"high-priority jobs did not all finish: {gold_outcomes}")
+    gold_p95 = gold.get("p95_latency_s")
+    if gold_p95 is not None and gold_p95 > SLO_TARGET_S:
+        fail(
+            f"high-priority p95 {gold_p95}s blew the "
+            f"{SLO_TARGET_S}s target"
+        )
+
+    shed_tally = storm.get("shed") or {}
+    brownout_shed = sum(
+        v for k, v in shed_tally.items() if "brownout" in k
+    )
+    summary = {
+        "ok": True,
+        "scale_ups": len(scale_ups),
+        "max_brownout_level": max(levels, default=0),
+        "replica_exits": sum(
+            1 for d in decisions if d.get("ev") == "replica_exit"
+        ),
+        "replicas_spawned": sum(
+            1 for d in decisions if d.get("ev") == "replica_spawned"
+        ),
+        "storm_submitted": storm["submitted"],
+        "storm_accepted": storm["accepted"],
+        "brownout_shed_429s": brownout_shed,
+        "gold": gold,
+    }
+    print(json.dumps(summary, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,651 @@
+"""Autoscaler + brownout tests: policy, supervisor loop, admission.
+
+The ISSUE 17 acceptance surface: staged brownout levels with
+admission hysteresis; priority-ordered shedding (low first, then
+normal, never high) enforced in the admission queues from the
+supervisor's published posture; the brownout 429's ``Retry-After``
+priced from the shed class's un-shed horizon (NOT the global
+per-micrograph estimate); the supervisor's scale decisions —
+hysteresis, cooldown, min/max bounds, dead-replica replacement
+without cooldown — each journaled with its triggering signals; the
+``scale_stall`` / ``storm`` fault sites; the operator kill switches;
+and EDF-within-fairness dealing in the continuous batcher once the
+budget burns.
+"""
+
+import json
+import os
+
+import pytest
+
+from repic_tpu.runtime import faults
+from repic_tpu.serve import autoscale
+from repic_tpu.serve.autoscale import (
+    BrownoutReader,
+    Supervisor,
+    brownout_level,
+    effective_queue_limit,
+    shed_horizon_s,
+    shed_priorities,
+)
+from repic_tpu.serve.jobs import (
+    AdmissionError,
+    JobQueue,
+    ServeJournal,
+)
+from repic_tpu.serve.tenancy import TenantRegistry, TenantSpec
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- brownout policy ---------------------------------------------------
+
+
+def test_brownout_levels_are_staged():
+    assert brownout_level(0.0) == 0
+    assert brownout_level(1.9) == 0
+    assert brownout_level(2.0) == 1
+    assert brownout_level(6.0) == 2
+    assert brownout_level(14.0) == 3
+    assert brownout_level(1e9) == 3
+
+
+def test_brownout_exit_hysteresis():
+    """A level entered at its threshold is only left once burn falls
+    below EXIT_FRACTION of that threshold — no shed/admit flapping
+    right at the boundary."""
+    # burn dips just under the level-1 threshold: still level 1
+    assert brownout_level(1.5, prev=1) == 1
+    # below half the threshold: clean exit
+    assert brownout_level(0.9, prev=1) == 0
+    # a fall from 2 through the band holds each stage's hysteresis
+    assert brownout_level(4.0, prev=2) == 2   # >= 6 * 0.5
+    assert brownout_level(2.5, prev=2) == 1   # < 3, >= 1
+    assert brownout_level(0.5, prev=2) == 0
+    # rising through levels needs no history
+    assert brownout_level(20.0, prev=1) == 3
+
+
+def test_shed_priorities_ordering():
+    """low sheds first, then normal; high survives every stage."""
+    assert shed_priorities(0) == ()
+    assert shed_priorities(1) == ("low",)
+    assert shed_priorities(2) == ("low", "normal")
+    assert shed_priorities(3) == ("low", "normal")
+    assert "high" not in shed_priorities(3)
+
+
+def test_effective_queue_limit_halves_at_level3():
+    assert effective_queue_limit(8, 0) == 8
+    assert effective_queue_limit(8, 2) == 8
+    assert effective_queue_limit(8, 3) == 4
+    assert effective_queue_limit(1, 3) == 1  # never to zero
+
+
+def test_shed_horizon_prices_class_not_global():
+    """Satellite: the brownout Retry-After is the shed CLASS's
+    horizon — control interval + remaining cooldown + the un-shed
+    backlog's drain — not the global per-micrograph estimate."""
+    state = {"interval_s": 2.0, "cooldown_remaining_s": 6.0}
+    # 10 un-shed micrographs at 3 s/mic over 2 replicas = 15 s drain
+    assert shed_horizon_s(state, 10, 3.0, live=2) == 2.0 + 6.0 + 15.0
+    # floor: at least one control interval even with nothing queued
+    assert shed_horizon_s({}, 0, 3.0) == 2.0
+    assert shed_horizon_s(None, 0, 0.0) == 2.0
+
+
+# -- posture file / BrownoutReader ------------------------------------
+
+
+def _publish_state(root, **fields):
+    doc = {
+        "level": 0,
+        "interval_s": 2.0,
+        "cooldown_remaining_s": 0.0,
+        **fields,
+    }
+    with open(os.path.join(root, autoscale.STATE_NAME), "w") as f:
+        json.dump(doc, f)
+    return doc
+
+
+def test_brownout_reader_absent_file_is_level0(tmp_path):
+    r = BrownoutReader(str(tmp_path))
+    assert r.state() is None
+    assert r.level() == 0
+
+
+def test_brownout_reader_tracks_rewrites(tmp_path):
+    r = BrownoutReader(str(tmp_path))
+    _publish_state(str(tmp_path), level=2)
+    assert r.level() == 2
+    # rewrite with different content AND size: must re-read
+    _publish_state(str(tmp_path), level=0, note="recovered")
+    assert r.level() == 0
+    # file removed: fails open to level 0
+    os.unlink(os.path.join(str(tmp_path), autoscale.STATE_NAME))
+    assert r.level() == 0
+
+
+def test_brownout_reader_tolerates_garbage(tmp_path):
+    with open(os.path.join(str(tmp_path), autoscale.STATE_NAME),
+              "w") as f:
+        f.write("{not json")
+    assert BrownoutReader(str(tmp_path)).level() == 0
+
+
+# -- admission shedding -----------------------------------------------
+
+
+def _registry():
+    return TenantRegistry([
+        TenantSpec(name="gold", keys=("kg",), priority="high"),
+        TenantSpec(name="std", keys=("ks",)),
+        TenantSpec(name="batch", keys=("kb",), priority="low"),
+    ])
+
+
+def test_brownout_sheds_by_priority_class(tmp_path):
+    """Level 1 sheds only low; level 2 sheds normal too; high is
+    admitted at every level."""
+    q = JobQueue(8, ServeJournal(str(tmp_path)),
+                 tenants=_registry())
+    _publish_state(str(tmp_path), level=1)
+    with pytest.raises(AdmissionError) as exc:
+        q.submit({"r": 1}, tenant="batch")
+    assert exc.value.http_status == 429
+    assert exc.value.reason == "brownout"
+    q.submit({"r": 2}, tenant="std")    # normal still admitted
+    q.submit({"r": 3}, tenant="gold")
+    _publish_state(str(tmp_path), level=2)
+    with pytest.raises(AdmissionError):
+        q.submit({"r": 4}, tenant="std")
+    with pytest.raises(AdmissionError):
+        q.submit({"r": 5}, tenant=None)  # no tenancy -> normal
+    q.submit({"r": 6}, tenant="gold")    # high never shed
+
+
+def test_brownout_recovery_readmits(tmp_path):
+    q = JobQueue(8, ServeJournal(str(tmp_path)),
+                 tenants=_registry())
+    _publish_state(str(tmp_path), level=1)
+    with pytest.raises(AdmissionError):
+        q.submit({"r": 1}, tenant="batch")
+    _publish_state(str(tmp_path), level=0)
+    q.submit({"r": 2}, tenant="batch")
+
+
+def test_level3_tightens_queue_limit(tmp_path):
+    q = JobQueue(4, ServeJournal(str(tmp_path)),
+                 tenants=_registry())
+    _publish_state(str(tmp_path), level=3)
+    q.submit({"r": 1}, tenant="gold")
+    q.submit({"r": 2}, tenant="gold")
+    # effective limit is 4 // 2 = 2: the third high-priority job hits
+    # queue_full even though the configured limit is 4
+    with pytest.raises(AdmissionError) as exc:
+        q.submit({"r": 3}, tenant="gold")
+    assert exc.value.reason == "queue_full"
+
+
+def test_brownout_retry_after_uses_class_horizon(tmp_path):
+    """The shed tenant's 429 prices interval + cooldown + un-shed
+    drain, not the global estimate over ALL queued micrographs."""
+    q = JobQueue(32, ServeJournal(str(tmp_path)),
+                 tenants=_registry())
+    q._avg_mic_s = 3.0
+    # 6 un-shed (normal-priority) micrographs queued before brownout
+    q.submit({"r": 1}, micrographs=6, tenant="std")
+    _publish_state(str(tmp_path), level=1,
+                   interval_s=2.0, cooldown_remaining_s=4.0)
+    with pytest.raises(AdmissionError) as exc:
+        q.submit({"r": 2}, micrographs=100, tenant="batch")
+    # 2 (interval) + 4 (cooldown) + 6 * 3.0 (un-shed drain) = 24
+    assert exc.value.retry_after_s == 24
+
+
+def test_brownout_retry_after_excludes_shed_backlog(tmp_path):
+    """Only the still-admitted classes' backlog counts toward the
+    horizon: queued low-priority work will not run ahead of the
+    retrying client's own class."""
+    clk = Clock()
+    q = JobQueue(32, ServeJournal(str(tmp_path)),
+                 tenants=_registry(), clock=clk)
+    q._avg_mic_s = 3.0
+    q.submit({"r": 1}, micrographs=50, tenant="batch")  # low, queued
+    q.submit({"r": 2}, micrographs=2, tenant="std")
+    _publish_state(str(tmp_path), level=1, interval_s=2.0,
+                   cooldown_remaining_s=0.0)
+    with pytest.raises(AdmissionError) as exc:
+        q.submit({"r": 3}, tenant="batch")
+    # 2 + 2 * 3.0 = 8 — the 50 shed-class micrographs do not count
+    assert exc.value.retry_after_s == 8
+
+
+# -- supervisor decisions ---------------------------------------------
+
+
+class FakeProc:
+    def __init__(self):
+        self.terminated = False
+        self._code = None
+
+    def poll(self):
+        return self._code
+
+    def terminate(self):
+        self.terminated = True
+        self._code = 0
+
+    def kill(self):
+        self._code = -9
+
+    def wait(self, timeout=None):
+        return self._code
+
+    def die(self, code=-9):
+        self._code = code
+
+
+def _supervisor(tmp_path, clk, env=None, **kw):
+    spawned = []
+
+    def spawn(name, wd):
+        proc = FakeProc()
+        spawned.append((name, proc))
+        return proc
+
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 3)
+    kw.setdefault("cooldown_s", 10.0)
+    sup = Supervisor(
+        str(tmp_path), clock=clk, spawn=spawn,
+        env=env if env is not None else {}, **kw,
+    )
+    # signal sampling is driven by tests, not by real artifacts
+    sup._live_replicas = lambda: len(sup.managed)
+    sup._queue_depth = lambda: (0, 0, 0)
+    sup._budget_burn = lambda: 0.0
+    return sup, spawned
+
+
+def test_supervisor_scales_up_on_burn_and_journals_signals(tmp_path):
+    clk = Clock()
+    sup, spawned = _supervisor(tmp_path, clk)
+    rec = sup.tick()  # settles at min_replicas
+    assert rec["action"] == "hold" and sup.target == 1
+    assert len(sup.managed) == 1
+    sup._budget_burn = lambda: 5.0
+    clk.advance(2.0)
+    rec = sup.tick()
+    assert rec["action"] == "up"
+    assert rec["reason"]["cause"] == "burn"
+    assert rec["signals"]["burn"] == 5.0
+    assert sup.target == 2 and len(sup.managed) == 2
+    # every decision lands in the journal with its signals
+    decisions = autoscale.read_decisions(str(tmp_path))
+    ups = [d for d in decisions if d.get("action") == "up"]
+    assert ups and ups[0]["signals"]["burn"] == 5.0
+    sup.shutdown()
+
+
+def test_supervisor_cooldown_prevents_flapping(tmp_path):
+    clk = Clock()
+    sup, _ = _supervisor(tmp_path, clk, max_replicas=5)
+    sup._budget_burn = lambda: 5.0
+    assert sup.tick()["action"] == "up"
+    clk.advance(1.0)  # inside the 10 s cooldown
+    rec = sup.tick()
+    assert rec["action"] == "hold"
+    assert rec["reason"]["cause"] == "cooldown"
+    clk.advance(10.0)
+    assert sup.tick()["action"] == "up"
+    sup.shutdown()
+
+
+def test_supervisor_scales_up_on_depth_and_holds_at_max(tmp_path):
+    clk = Clock()
+    sup, _ = _supervisor(tmp_path, clk, max_replicas=2)
+    sup._queue_depth = lambda: (50, 200, 0)
+    rec = sup.tick()
+    assert rec["action"] == "up"
+    assert rec["reason"]["cause"] == "depth"
+    clk.advance(20.0)
+    rec = sup.tick()
+    assert rec["action"] == "hold"
+    assert rec["reason"]["cause"] == "at_max"
+    assert sup.target == 2
+    sup.shutdown()
+
+
+def test_supervisor_scales_down_only_when_drained(tmp_path):
+    clk = Clock()
+    sup, _ = _supervisor(tmp_path, clk)
+    sup._budget_burn = lambda: 5.0
+    sup.tick()
+    clk.advance(20.0)
+    # burn recovered but a lease is outstanding: no scale-in
+    sup._budget_burn = lambda: 0.0
+    sup._queue_depth = lambda: (0, 0, 1)
+    assert sup.tick()["action"] == "hold"
+    clk.advance(20.0)
+    sup._queue_depth = lambda: (0, 0, 0)
+    rec = sup.tick()
+    assert rec["action"] == "down"
+    assert rec["reason"]["cause"] == "idle"
+    assert sup.target == 1 and len(sup.managed) == 1
+    sup.shutdown()
+
+
+def test_supervisor_replaces_dead_replica_without_cooldown(tmp_path):
+    """The chaos-CI SIGKILL shape: a dead managed replica is reaped
+    (journaled with its exit code) and replaced on the SAME tick —
+    replacement holds the target, so it never waits out the scale
+    cooldown."""
+    clk = Clock()
+    sup, spawned = _supervisor(tmp_path, clk)
+    sup.tick()
+    assert len(spawned) == 1
+    spawned[0][1].die(-9)
+    clk.advance(0.5)  # well inside any cooldown
+    sup.tick()
+    assert len(spawned) == 2
+    assert len(sup.managed) == 1
+    events = [
+        d["ev"] for d in autoscale.read_decisions(str(tmp_path))
+    ]
+    assert "replica_exit" in events
+    exit_rec = next(
+        d for d in autoscale.read_decisions(str(tmp_path))
+        if d.get("ev") == "replica_exit"
+    )
+    assert exit_rec["returncode"] == -9
+    sup.shutdown()
+
+
+def test_supervisor_disable_env_holds_all_actions(tmp_path):
+    """Kill switch: decisions are still made and journaled, but the
+    replica set never changes."""
+    clk = Clock()
+    env = {autoscale.DISABLE_ENV: "1"}
+    sup, spawned = _supervisor(tmp_path, clk, env=env)
+    sup._budget_burn = lambda: 50.0
+    rec = sup.tick()
+    assert rec["action"] == "hold"
+    assert rec["reason"].get("held") is True
+    assert spawned == [] and sup.managed == {}
+    assert autoscale.read_state(str(tmp_path))["disabled"] is True
+    sup.shutdown()
+
+
+def test_supervisor_target_env_pins_and_clamps(tmp_path):
+    clk = Clock()
+    env = {autoscale.TARGET_ENV: "99"}
+    sup, spawned = _supervisor(tmp_path, clk, max_replicas=2,
+                               env=env)
+    rec = sup.tick()
+    assert rec["action"] == "pin"
+    assert sup.target == 2  # clamped to max_replicas
+    assert len(sup.managed) == 2
+    env[autoscale.TARGET_ENV] = "0"
+    clk.advance(2.0)
+    sup.tick()
+    assert sup.target == 1  # clamped to min_replicas
+    sup.shutdown()
+
+
+def test_supervisor_publishes_posture(tmp_path):
+    clk = Clock()
+    sup, _ = _supervisor(tmp_path, clk)
+    sup._budget_burn = lambda: 7.0  # level 2
+    sup.tick()
+    state = autoscale.read_state(str(tmp_path))
+    assert state["level"] == 2
+    assert state["shed_priorities"] == ["low", "normal"]
+    assert state["burn"] == 7.0
+    assert state["target"] == sup.target
+    assert state["managed"] == sorted(sup.managed)
+    # and the same posture feeds the admission-side reader
+    assert BrownoutReader(str(tmp_path)).level() == 2
+    sup.shutdown()
+
+
+def test_supervisor_rejects_bad_bounds(tmp_path):
+    with pytest.raises(ValueError):
+        Supervisor(str(tmp_path), min_replicas=0)
+    with pytest.raises(ValueError):
+        Supervisor(str(tmp_path), min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        Supervisor(str(tmp_path), brownout_thresholds=(4.0, 2.0))
+    with pytest.raises(ValueError):
+        Supervisor(str(tmp_path), brownout_thresholds=(0.0,))
+
+
+# -- fault sites -------------------------------------------------------
+
+
+@pytest.mark.faults
+def test_scale_stall_fault_wedges_one_tick(tmp_path):
+    """A ``scale_stall`` firing journals the decision as stalled and
+    does NOT act on it — the fleet keeps its last size; the next
+    tick proceeds normally."""
+    clk = Clock()
+    sup, spawned = _supervisor(tmp_path, clk)
+    sup._budget_burn = lambda: 50.0
+    with faults.fault_plan("scale_stall:tick:0:1"):
+        rec = sup.tick()
+        assert rec["action"] == "stall"
+        assert spawned == []  # not even the min-replica spawn ran
+        clk.advance(2.0)
+        rec = sup.tick()
+    assert rec["action"] == "up"
+    assert len(spawned) == 2
+    stalls = [
+        d for d in autoscale.read_decisions(str(tmp_path))
+        if d.get("action") == "stall"
+    ]
+    assert len(stalls) == 1 and stalls[0]["tick"] == 0
+    sup.shutdown()
+
+
+@pytest.mark.faults
+def test_storm_fault_substitutes_saturated_signals(tmp_path):
+    """A ``storm`` firing is the deterministic traffic storm: burn
+    and depth saturate (the decision record carries storm=True), the
+    supervisor scales up, and brownout jumps to the top stage."""
+    clk = Clock()
+    sup, _ = _supervisor(tmp_path, clk)
+    with faults.fault_plan("storm:tick:0:1"):
+        rec = sup.tick()
+    assert rec.get("storm") is True
+    assert rec["action"] == "up"
+    assert rec["signals"]["burn"] == autoscale.STORM_BURN
+    assert sup.level == 3
+    state = autoscale.read_state(str(tmp_path))
+    assert state["shed_priorities"] == ["low", "normal"]
+    # next tick sees real (calm) signals again, but the brownout
+    # level exits through hysteresis, not instantly
+    clk.advance(2.0)
+    rec = sup.tick()
+    assert "storm" not in rec
+    assert sup.level == 0  # burn 0.0 is below every exit threshold
+    sup.shutdown()
+
+
+@pytest.mark.faults
+def test_fault_site_coverage_gate():
+    """Satellite: every KNOWN_SITES entry must be exercised by at
+    least one ``faults``-marked test — a new fault site without a
+    chaos test fails CI here, not in production."""
+    import re
+
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    sources = []
+    for name in sorted(os.listdir(tests_dir)):
+        if not (name.startswith("test_") and name.endswith(".py")):
+            continue
+        with open(os.path.join(tests_dir, name)) as f:
+            text = f.read()
+        has_module_mark = re.search(
+            r"^pytestmark\s*=.*\bfaults\b", text, re.M
+        )
+        # split on test functions; keep a chunk if the module is
+        # faults-marked or the function carries the marker directly
+        chunks = re.split(r"(?=^def test_|^@pytest\.mark)", text,
+                          flags=re.M)
+        marked = False
+        for chunk in chunks:
+            if chunk.startswith("@pytest.mark.faults"):
+                marked = True
+                continue
+            if chunk.startswith("def test_"):
+                if marked or has_module_mark:
+                    sources.append(chunk)
+                marked = False
+            elif not chunk.startswith("@pytest.mark"):
+                marked = False
+        # worker scripts spawned BY faults tests count too when the
+        # module is faults-marked
+        if has_module_mark:
+            sources.append(text)
+    blob = "\n".join(sources)
+    missing = [
+        site for site in faults.KNOWN_SITES if site not in blob
+    ]
+    assert not missing, (
+        f"fault sites with no faults-marked test coverage: {missing}"
+    )
+
+
+# -- EDF dealing in the batcher ---------------------------------------
+
+
+def _edf_batcher(burn):
+    from repic_tpu.serve.batcher import ContinuousBatcher
+
+    class FakeSLO:
+        def budget_burn(self, endpoint):
+            return burn
+
+    class FakeDaemon:
+        slo = FakeSLO()
+
+    b = ContinuousBatcher.__new__(ContinuousBatcher)
+    b.daemon = FakeDaemon()
+    b._open = []
+    b._last_key = None
+    b._last_capacity = None
+    b._streak = 0
+    b._rr = -1
+    b._dealing = "round_robin"
+    return b
+
+
+def _open_jobs(key):
+    class FakeJob:
+        def __init__(self, ts, deadline, tenant=None):
+            self.accepted_ts = ts
+            self.deadline_ts = deadline
+            self.tenant = tenant
+
+    class FakeOpen:
+        num_pickers = 3
+
+        def __init__(self, name, deadline, ts, pending=6,
+                     tenant=None):
+            self.name = name
+            self.job = FakeJob(ts, deadline, tenant)
+            self.key = key
+            self.pending = [
+                (f"{name}-{i:03d}", None) for i in range(pending)
+            ]
+
+    return FakeOpen
+
+
+def _coalesce_key():
+    from repic_tpu.serve.batcher import CoalesceKey
+
+    return CoalesceKey(
+        bucket_key=(3, 64, 0.3, "greedy"), box_sizes=(180.0,),
+        max_neighbors=16, use_mesh=False, spatial=None,
+        use_pallas=False, n_dev=1,
+    )
+
+
+def test_edf_orders_by_deadline_under_burn():
+    """Satellite: a synthetic deadline crunch — with the budget
+    burning, the tightest deadline is dealt first (gets the larger
+    share of an uneven deal); None-deadline jobs go last."""
+    key = _coalesce_key()
+    FakeOpen = _open_jobs(key)
+    b = _edf_batcher(burn=5.0)
+    relaxed = FakeOpen("relaxed", deadline=900.0, ts=1.0)
+    urgent = FakeOpen("urgent", deadline=10.0, ts=3.0)
+    open_ended = FakeOpen("open", deadline=None, ts=2.0)
+    b._open = [relaxed, urgent, open_ended]
+    parts = b._select()
+    assert b._dealing == "edf"
+    order = [oj.name for oj, _ in parts]
+    assert order[0] == "urgent"
+    assert order[-1] == "open"  # no deadline sorts last
+    # leftover slots of the uneven deal went to the urgent job
+    dealt = {oj.name: len(items) for oj, items in parts}
+    assert dealt["urgent"] >= dealt["relaxed"]
+    assert dealt["urgent"] >= dealt["open"]
+
+
+def test_round_robin_restored_when_calm():
+    key = _coalesce_key()
+    FakeOpen = _open_jobs(key)
+    b = _edf_batcher(burn=0.0)
+    b._open = [
+        FakeOpen("a", deadline=10.0, ts=1.0),
+        FakeOpen("b", deadline=900.0, ts=2.0),
+    ]
+    b._select()
+    assert b._dealing == "round_robin"
+    # the rotation advanced (EDF would leave _rr untouched)
+    assert b._rr == 0
+
+
+def test_edf_respects_tenant_fairness():
+    """EDF reorders urgency WITHIN the per-tenant one-slot-per-round
+    deal: a tight-deadline tenant with many jobs cannot starve a
+    quiet tenant's single job out of the chunk."""
+    key = _coalesce_key()
+    FakeOpen = _open_jobs(key)
+    b = _edf_batcher(burn=5.0)
+    noisy = [
+        FakeOpen(f"noisy{i}", deadline=float(i + 1), ts=float(i),
+                 pending=20, tenant="noisy")
+        for i in range(3)
+    ]
+    quiet = FakeOpen("quiet", deadline=None, ts=9.0, pending=2,
+                     tenant="quiet")
+    b._open = noisy + [quiet]
+    parts = b._select()
+    dealt = {oj.name: len(items) for oj, items in parts}
+    # the quiet tenant's job was dealt despite having no deadline
+    assert dealt.get("quiet", 0) >= 1
+
+
+def test_edf_triggers_on_brownout_without_burn(tmp_path):
+    """Brownout posture alone flips dealing to EDF even if this
+    replica's own window has not burned yet (the supervisor has
+    fleet-wide signals this replica lacks)."""
+    b = _edf_batcher(burn=None)
+    assert b._edf_active() is False
+    q = JobQueue(8, ServeJournal(str(tmp_path)))
+    b.queue = q
+    _publish_state(str(tmp_path), level=1)
+    assert b._edf_active() is True

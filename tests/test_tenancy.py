@@ -124,6 +124,44 @@ def test_parse_tenants_rejects_malformations():
             parse_tenants(doc)
 
 
+def test_parse_tenants_priority_round_trip():
+    """ISSUE 17: the ``priority`` brownout class parses, defaults to
+    ``normal``, and resolves through the registry (unknown/None
+    tenants read as normal — shedding must never KeyError)."""
+    specs = parse_tenants(
+        {
+            "tenants": [
+                {"name": "gold", "keys": ["kg"],
+                 "priority": "high"},
+                {"name": "std", "keys": ["ks"]},
+                {"name": "bulk", "keys": ["kb"],
+                 "priority": "low"},
+            ]
+        }
+    )
+    assert [s.priority for s in specs] == ["high", "normal", "low"]
+    reg = TenantRegistry(specs)
+    assert reg.priority("gold") == "high"
+    assert reg.priority("std") == "normal"
+    assert reg.priority("bulk") == "low"
+    assert reg.priority("unknown") == "normal"
+    assert reg.priority(None) == "normal"
+    assert reg.describe("gold")["priority"] == "high"
+
+
+def test_parse_tenants_rejects_bad_priority():
+    for bad_priority in ("critical", "", 3, None):
+        with pytest.raises(ValueError):
+            parse_tenants(
+                {
+                    "tenants": [
+                        {"name": "a", "keys": ["k"],
+                         "priority": bad_priority}
+                    ]
+                }
+            )
+
+
 def test_load_tenants_unreadable_file_is_valueerror(tmp_path):
     with pytest.raises(ValueError):
         tenancy.load_tenants(str(tmp_path / "nope.json"))
