@@ -16,13 +16,20 @@ conflicts are deepest: the BASELINE stress configs —
   (configs[4] shape; sizes as tests/test_mixed_e2e.py).
 
 For each micrograph it runs the fused consensus once per device
-backend (greedy, lp), then solves the identical packing problem with
-the exact native branch-and-bound (ops/solver.py:solve_exact — the
-Gurobi replacement, reference run_ilp.py:50-63) and reports
+backend (greedy, lp, lp_device — the batched dual-decomposition
+solver, repic_tpu/solver/), then solves the identical packing problem
+with the exact native branch-and-bound (ops/solver.py:solve_exact —
+the Gurobi replacement, reference run_ilp.py:50-63) and reports
 
     objective ratio   sum(w[picked]) / sum(w[exact])
     particle Jaccard  |reps_backend & reps_exact| / |union|
-    solver runtimes
+    solver runtimes + batched lp_device solve throughput
+
+Every lp_device packing is also checked for feasibility (no particle
+vertex in two picked cliques); an infeasible packing is a hard bench
+failure.  ``--gate X`` turns the report into a CI gate: exit non-zero
+when any lp_device min-Jaccard falls below X or any packing is
+infeasible.
 
 One JSON line per workload; ``--out`` also appends them to an artifact
 file (SOLVER_QUALITY_*.json) that docs/tpu.md numbers must cite.
@@ -38,6 +45,9 @@ import time
 import numpy as np
 
 from bench_stress import synthesize
+
+#: device backends measured against the exact oracle, in report order
+SOLVERS = ("greedy", "lp", "lp_device")
 
 
 def _mixed_synthesize(m, n, seed=0):
@@ -75,7 +85,7 @@ def run_workload(name, m, n, seed):
 
     res = {}
     times = {}
-    for solver in ("greedy", "lp"):
+    for solver in SOLVERS:
         t0 = time.time()
         r = run_consensus_batch(
             batch, box, use_mesh=False, solver=solver
@@ -83,6 +93,12 @@ def run_workload(name, m, n, seed):
         jax.block_until_ready(r.picked)
         times[solver] = time.time() - t0
         res[solver] = jax.device_get(r)
+    # batched solve throughput: the m micrographs solve in ONE device
+    # dispatch — re-run post-compile so the rate excludes tracing
+    t0 = time.time()
+    r = run_consensus_batch(batch, box, use_mesh=False, solver="lp_device")
+    jax.block_until_ready(r.picked)
+    solve_s = time.time() - t0
 
     out = {
         "workload": name,
@@ -107,7 +123,7 @@ def run_workload(name, m, n, seed):
             "obj_exact": round(obj_exact, 4),
             "exact_solve_s": round(exact_s, 3),
         }
-        for solver in ("greedy", "lp"):
+        for solver in SOLVERS:
             rv = np.asarray(res[solver].valid[i])
             picked = np.asarray(res[solver].picked[i])[rv]
             wv = np.asarray(res[solver].w[i])[rv].astype(np.float64)
@@ -119,9 +135,20 @@ def run_workload(name, m, n, seed):
             row[f"jaccard_{solver}"] = round(
                 len(reps & reps_exact) / len(union) if union else 1.0, 6
             )
+            if solver == "lp_device":
+                memv = np.asarray(res[solver].member_idx[i])[rv]
+                vidv = memv + np.arange(k)[None, :] * batch.capacity
+                used = vidv[picked].ravel()
+                row["feasible_lp_device"] = bool(
+                    len(np.unique(used)) == used.size
+                )
         out["per_micrograph"].append(row)
 
-    for solver in ("greedy", "lp"):
+    out["lp_device_solves_per_s"] = round(m / solve_s, 2)
+    out["feasible_lp_device"] = all(
+        r["feasible_lp_device"] for r in out["per_micrograph"]
+    )
+    for solver in SOLVERS:
         out[f"min_jaccard_{solver}"] = min(
             r[f"jaccard_{solver}"] for r in out["per_micrograph"]
         )
@@ -143,6 +170,12 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", help="append JSON lines to this artifact")
     ap.add_argument(
+        "--gate", type=float, metavar="MIN_JACCARD",
+        help="CI gate: exit 1 when any workload's lp_device "
+        "min-Jaccard vs exact falls below this, or any lp_device "
+        "packing is infeasible",
+    )
+    ap.add_argument(
         "--device", action="store_true",
         help="run on the default (device) backend instead of CPU",
     )
@@ -163,6 +196,7 @@ def main():
 
         jax.config.update("jax_platforms", "cpu")
 
+    failures = []
     for wl in args.workloads.split(","):
         out = run_workload(wl.strip(), args.m, args.n, args.seed)
         line = json.dumps(out)
@@ -170,6 +204,20 @@ def main():
         if args.out:
             with open(args.out, "at") as f:
                 f.write(line + "\n")
+        if args.gate is not None:
+            if not out["feasible_lp_device"]:
+                failures.append(f"{out['workload']}: infeasible "
+                                "lp_device packing")
+            if out["min_jaccard_lp_device"] < args.gate:
+                failures.append(
+                    f"{out['workload']}: min_jaccard_lp_device "
+                    f"{out['min_jaccard_lp_device']} < {args.gate}"
+                )
+    if failures:
+        for msg in failures:
+            print(f"GATE FAIL {msg}", file=sys.stderr)
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

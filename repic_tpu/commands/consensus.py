@@ -77,12 +77,15 @@ def add_arguments(parser):
     )
     parser.add_argument(
         "--solver",
-        choices=["greedy", "lp", "exact"],
-        default="greedy",
-        help="packing backend: parallel greedy dominance, LP "
-        "relaxation + rounding (never worse than greedy), or the "
-        "exact host-side branch-and-bound (degrades exact -> lp -> "
-        "greedy under --solver_budget, recorded in the journal)",
+        choices=["greedy", "lp", "lp_device", "exact"],
+        default="lp_device",
+        help="packing backend: on-device dual-decomposition LP "
+        "(lp_device, the default — solves inside the batched device "
+        "program, degrading lp_device -> lp -> greedy on "
+        "non-convergence), parallel greedy dominance, LP relaxation "
+        "+ rounding, or the exact host-side branch-and-bound "
+        "(degrades exact -> lp -> greedy under --solver_budget, "
+        "recorded in the journal)",
     )
     parser.add_argument(
         "--solver_budget",

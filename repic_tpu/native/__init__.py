@@ -169,12 +169,16 @@ def solve_exact_native(
     w: np.ndarray,
     *,
     node_limit: int = 2_000_000,
+    fallback_log: list | None = None,
 ) -> np.ndarray | None:
     """Exact max-weight set packing via the C++ core.
 
     Same contract as :func:`repic_tpu.ops.solver.solve_exact_py`;
     returns None when the native library is unavailable so callers can
-    fall back.
+    fall back.  ``fallback_log`` (optional list) receives one
+    ``{"components": n}`` entry when the core reports ``n`` components
+    that hit the node limit and fell back to greedy — the same
+    degradation surface the Python oracle logs per component.
     """
     lib = _load("setpack", _configure_setpack)
     if lib is None:
@@ -201,4 +205,6 @@ def solve_exact_native(
     )
     if rc < 0:
         raise RuntimeError(f"setpack_solve failed with rc={rc}")
+    if rc > 0 and fallback_log is not None:
+        fallback_log.append({"components": int(rc)})
     return out.astype(bool)

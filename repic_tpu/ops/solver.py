@@ -251,6 +251,7 @@ def solve_exact_py(
     node_limit: int = 2_000_000,
     deadline: float | None = None,
     raise_on_limit: bool = False,
+    fallback_log: list | None = None,
 ) -> np.ndarray:
     """Exact maximum-weight set packing (host-side oracle).
 
@@ -274,11 +275,18 @@ def solve_exact_py(
             :class:`SolverBudgetExceeded` when passed (the runtime's
             solver ladder then degrades to LP-rounding/greedy).
         raise_on_limit: raise :class:`SolverBudgetExceeded` on a
-            node_limit hit instead of the silent per-component greedy
+            node_limit hit instead of the per-component greedy
             fallback.
+        fallback_log: optional list; every per-component greedy
+            fallback appends ``{"component": id, "cliques": n}`` to
+            it, so callers (the runtime ladder) can journal the
+            degradation instead of letting it pass with only the
+            process-wide counter moving.
 
     Returns:
-        ``(C,)`` bool — optimal selection.
+        ``(C,)`` bool — optimal selection (unless ``fallback_log``
+        came back non-empty: then >= 1 component fell back to
+        greedy and the packing is only heuristic there).
     """
     import time as _time
 
@@ -390,6 +398,10 @@ def solve_exact_py(
             )
         if aborted:
             _NODE_LIMIT_FALLBACKS.inc()
+            if fallback_log is not None:
+                fallback_log.append(
+                    {"component": int(cid), "cliques": int(n)}
+                )
             # Greedy fallback (never expected on real data).
             blocked_set: set[int] = set()
             best_sel = []
@@ -409,6 +421,7 @@ def solve_exact(
     *,
     node_limit: int = 2_000_000,
     budget_s: float | None = None,
+    fallback_log: list | None = None,
 ) -> np.ndarray:
     """Exact max-weight set packing, preferring the native C++ core.
 
@@ -422,6 +435,14 @@ def solve_exact(
     mid-search) and raises :class:`SolverBudgetExceeded` when either
     the deadline or ``node_limit`` is hit — the contract the runtime's
     degradation ladder builds on.
+
+    ``fallback_log`` (optional list) receives an entry per node-limit
+    greedy fallback on the unbudgeted path — see
+    :func:`solve_exact_py`.  A non-empty log means the returned
+    packing is NOT exact everywhere; the runtime ladder reports such
+    a solve as the ``exact_fallback`` rung so the degradation lands
+    in the journal instead of only in
+    ``repic_solver_node_limit_fallbacks_total``.
     """
     if budget_s is not None:
         import time as _time
@@ -436,12 +457,18 @@ def solve_exact(
     from repic_tpu import native
 
     out = native.solve_exact_native(
-        np.asarray(member_vertex), np.asarray(w), node_limit=node_limit
+        np.asarray(member_vertex),
+        np.asarray(w),
+        node_limit=node_limit,
+        fallback_log=fallback_log,
     )
     if out is not None:
         return out
     return solve_exact_py(
-        np.asarray(member_vertex), np.asarray(w), node_limit=node_limit
+        np.asarray(member_vertex),
+        np.asarray(w),
+        node_limit=node_limit,
+        fallback_log=fallback_log,
     )
 
 

@@ -56,6 +56,7 @@ from repic_tpu.runtime.ladder import (
     is_oom_error,
     solve_host_ladder,
 )
+from repic_tpu.solver import note_program_solves, solve_lp_device
 from repic_tpu.telemetry import events as tlm_events
 from repic_tpu.telemetry import probes as tlm_probes
 from repic_tpu.telemetry import server as tlm_server
@@ -227,7 +228,7 @@ def consensus_one(
     clique_capacity: int = 4096,
     spatial_grid: int | None = None,
     cell_capacity: int = 64,
-    solver: str = "greedy",
+    solver: str = "lp_device",
     use_pallas: bool = False,
     partial_capacity: int | None = None,
 ) -> ConsensusResult:
@@ -236,8 +237,10 @@ def consensus_one(
     With ``spatial_grid`` set, neighbor search runs on the
     memory-bounded bucketed path (dense-field micrographs); otherwise
     the dense all-pairs kernel is used.  ``solver`` picks the packing
-    backend: ``"greedy"`` (parallel greedy dominance) or ``"lp"``
-    (LP relaxation + rounding, never worse than greedy).
+    backend: ``"lp_device"`` (the default — batched dual-decomposition
+    LP, :mod:`repic_tpu.solver.dual`), ``"lp"`` (LP relaxation +
+    rounding) or ``"greedy"`` (parallel greedy dominance); both LP
+    rungs are never worse than greedy.
     """
     n = xy.shape[1]
     # Bound the per-chunk candidate transient (anchors x D^(K-1)) to
@@ -282,7 +285,9 @@ def consensus_one(
     num_cliques = cs.num_valid
     cs = compact_cliques(cs, clique_capacity)
     vid, num_vertices = pack_cliques_for_solver(cs.member_idx, cs.valid, n)
-    if solver == "lp":
+    if solver == "lp_device":
+        picked = solve_lp_device(vid, cs.w, cs.valid, num_vertices)
+    elif solver == "lp":
         picked = solve_lp_rounding(vid, cs.w, cs.valid, num_vertices)
     else:
         picked = solve_greedy(vid, cs.w, cs.valid, num_vertices)
@@ -309,7 +314,7 @@ def make_batched_consensus(
     mesh=None,
     spatial_grid: int | None = None,
     cell_capacity: int = 64,
-    solver: str = "greedy",
+    solver: str = "lp_device",
     use_pallas: bool = False,
     partial_capacity: int | None = None,
 ):
@@ -332,7 +337,7 @@ def make_batched_consensus(
 @lru_cache(maxsize=64)
 def _make_batched_consensus(
     threshold, max_neighbors, clique_capacity, mesh,
-    spatial_grid, cell_capacity, solver="greedy", use_pallas=False,
+    spatial_grid, cell_capacity, solver="lp_device", use_pallas=False,
     partial_capacity=None,
 ):
     single = partial(
@@ -404,7 +409,7 @@ def gang_consensus_chunk(
     mesh=None,
     spatial_grid: int | None = None,
     cell_capacity: int = 64,
-    solver: str = "greedy",
+    solver: str = "lp_device",
     use_pallas: bool = False,
     partial_capacity: int | None = None,
 ) -> ConsensusResult:
@@ -786,7 +791,7 @@ def run_consensus_batch(
     clique_capacity: int | None = None,
     use_mesh: bool = True,
     spatial: bool | None = None,
-    solver: str = "greedy",
+    solver: str = "lp_device",
     use_pallas: bool = False,
     packed_probe: bool = False,
 ) -> "ConsensusResult | tuple[ConsensusResult, np.ndarray]":
@@ -942,6 +947,13 @@ def run_consensus_batch(
                 cell_capacity=cell_cap, partial_capacity=pcap,
             )
             continue
+        if solver == "lp_device":
+            # count the in-program device solves once the capacities
+            # are final (escalation retries re-solve the same
+            # micrographs); padding rows are not solves
+            note_program_solves(
+                sum(1 for n in batch.names if n)
+            )
         # This batch's exact requirement (the probes are true counts
         # once nothing overflows).  Components whose probe is
         # meaningless on this path (cell count off-grid, partials on
@@ -1414,6 +1426,62 @@ def _host_solve_chunk(
     return res._replace(picked=picked_all)
 
 
+def _maybe_diverge_fallback(
+    part, res, capacity, *, solver, outcomes, journal=None
+):
+    """Chaos hook for ``lp_device`` non-convergence (``solver_diverge``
+    fault site, docs/robustness.md).
+
+    The happy path solves inside the fused device program with no
+    per-micrograph host visibility, so real dual-ascent divergence
+    cannot be observed without re-fetching — exactly the round trip
+    the rung removes.  This hook is the deterministic stand-in: when
+    a fault plan is installed, each micrograph whose name matches a
+    planted ``solver_diverge`` firing has its device packing treated
+    as non-converged and re-solved on the HOST ladder
+    (``lp`` -> ``greedy``), with the rung recorded in
+    ``outcomes.solver`` (hence the journal) and the micrograph
+    marked degraded.  Returns ``(res, changed)`` — ``changed`` tells
+    the packed write path to re-render from the patched result
+    instead of the stale packed transfer.  Zero cost when no plan is
+    active (one attribute read).
+    """
+    if solver != "lp_device" or not faults.active():
+        return res, False
+    hit = [
+        (i, name)
+        for i, (name, _sets) in enumerate(part)
+        if faults.check("solver_diverge", name)
+    ]
+    if not hit:
+        return res, False
+    picked_all = np.array(np.asarray(res.picked), dtype=bool)
+    K = res.member_idx.shape[-1]
+    offsets = np.arange(K, dtype=np.int64) * int(capacity)
+    for i, name in hit:
+        valid = np.asarray(res.valid[i]).astype(bool)
+        member = np.asarray(res.member_idx[i])[valid].astype(np.int64)
+        wv = np.asarray(res.w[i])[valid]
+        vid = member + offsets[None, :] if member.size else member
+        picked_v, used = solve_host_ladder(
+            vid, wv, K * int(capacity), solver="lp"
+        )
+        row = np.zeros(picked_all.shape[1], bool)
+        row[np.where(valid)[0]] = picked_v
+        picked_all[i] = row
+        outcomes.solver[name] = used
+        outcomes.mark([name], "degraded")
+        if journal is not None:
+            journal.record_event(
+                "solver_degraded",
+                micrograph=name,
+                rung="lp_device",
+                fallback=used,
+                reason="diverged",
+            )
+    return res._replace(picked=picked_all), True
+
+
 # OOM classification now lives in the runtime ladder (one policy for
 # every consensus path); this alias keeps the historical name.
 _is_oom_error = is_oom_error
@@ -1461,7 +1529,7 @@ def run_consensus_dir(
     num_particles: int | None = None,
     use_mesh: bool = True,
     spatial: bool | None = None,
-    solver: str = "greedy",
+    solver: str = "lp_device",
     use_pallas: bool = False,
     multi_out: bool = False,
     get_cc: bool = False,
@@ -1508,7 +1576,12 @@ def run_consensus_dir(
     missing micrographs.  ``solver="exact"`` solves the packing
     host-side with the in-framework branch-and-bound; under
     ``solver_budget_s`` it degrades exact -> LP-rounding -> greedy
-    per micrograph, recording the degradation in the journal.
+    per micrograph, recording the degradation in the journal.  The
+    default ``"lp_device"`` rung solves in-program (no host round
+    trip); an injected ``solver_diverge`` fault makes a named
+    micrograph's device solve read as non-converged, re-solving it
+    on the host ladder (``lp`` -> ``greedy``) with the rung
+    journaled — the chaos rehearsal for dual-ascent divergence.
 
     Cluster mode (``cluster=ClusterConfig(...)``, docs/robustness.md
     "Cluster mode"): N hosts point at the SAME ``out_dir`` (and a
@@ -2070,6 +2143,17 @@ def run_consensus_dir(
                             strict=strict,
                         )
                     compute_s += time.time() - t_solve
+                res, diverged = _maybe_diverge_fallback(
+                    part, res, cbatch.capacity,
+                    solver=device_solver, outcomes=outcomes,
+                    journal=journal,
+                )
+                if diverged and not want_fetch:
+                    # the packed transfer predates the host re-solve:
+                    # re-render this chunk from the patched result
+                    # (in fetch mode the writer reads `res` directly
+                    # and `extra` carries the cc labels — keep it)
+                    extra = None
                 t2 = time.time()
                 with tlm_events.span("write", micrographs=len(part)):
                     if want_fetch:
@@ -2685,7 +2769,7 @@ def iter_consensus_chunks(
     max_neighbors: int = 16,
     use_mesh: bool = True,
     spatial: bool | None = None,
-    solver: str = "greedy",
+    solver: str = "lp_device",
     use_pallas: bool = False,
     extra_device_outputs=None,
     fetch: bool = False,

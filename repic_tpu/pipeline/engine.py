@@ -74,17 +74,17 @@ class ConsensusOptions:
     num_particles: int | None = None
     use_mesh: bool = True
     spatial: bool | None = None
-    solver: str = "greedy"
+    solver: str = "lp_device"
     use_pallas: bool = False
     strict: bool = False
     max_retries: int | None = None
 
     def __post_init__(self):
-        if self.solver not in ("greedy", "lp"):
+        if self.solver not in ("greedy", "lp", "lp_device"):
             raise ValueError(
-                f"engine solver must be 'greedy' or 'lp', got "
-                f"{self.solver!r} (the host-side 'exact' ladder is a "
-                "run_consensus_dir mode, not a serve mode)"
+                f"engine solver must be 'greedy', 'lp' or 'lp_device',"
+                f" got {self.solver!r} (the host-side 'exact' ladder "
+                "is a run_consensus_dir mode, not a serve mode)"
             )
 
     @classmethod
@@ -341,7 +341,7 @@ def consensus_chunk_program(
     clique_capacity: int = 4096,
     spatial_grid: int | None = None,
     cell_capacity: int = 64,
-    solver: str = "greedy",
+    solver: str = "lp_device",
     use_pallas: bool = False,
     partial_capacity: int | None = None,
 ):

@@ -17,6 +17,14 @@ site              raised at the matching call site
 ``solver_budget`` no exception — the solver ladder polls
                   :func:`check` and treats a firing as budget
                   exhaustion of that rung
+``solver_diverge`` no exception — polled by the solver ladder's
+                  ``lp_device`` rung (key: the rung name) and by the
+                  directory pipeline per micrograph (key: the
+                  micrograph name); a firing makes the on-device
+                  dual-decomposition solve read as NON-CONVERGED,
+                  degrading to the host ladder (``lp`` -> ``greedy``)
+                  with the rung journaled — the deterministic
+                  stand-in for dual-ascent divergence
 ``host_crash``    no exception — polled by
                   ``runtime.cluster.ClusterContext.crash_point``,
                   which terminates the process with
@@ -150,6 +158,7 @@ KNOWN_SITES = (
     "oom",
     "corrupt_box",
     "solver_budget",
+    "solver_diverge",
     "host_crash",
     "heartbeat_stall",
     "lease_race",

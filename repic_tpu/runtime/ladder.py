@@ -30,8 +30,10 @@ scattered failure handling (the ad-hoc OOM halving in
 
 Fault-injection hooks (:mod:`repic_tpu.runtime.faults`) cover every
 rung: ``oom``/``io`` fire in the chunk loop, ``solver_budget`` makes
-a named rung report exhaustion, and ``host_crash`` /
-``heartbeat_stall`` / ``lease_race`` exercise the host ladder.
+a named rung report exhaustion, ``solver_diverge`` makes the
+on-device ``lp_device`` rung report dual-ascent non-convergence, and
+``host_crash`` / ``heartbeat_stall`` / ``lease_race`` exercise the
+host ladder.
 """
 
 from __future__ import annotations
@@ -152,9 +154,13 @@ class ChunkOutcomes:
 
 
 # Degradation order per requested solver; every ladder ends on greedy,
-# which cannot exhaust a budget.
+# which cannot exhaust a budget.  The on-device dual-decomposition
+# rung (``lp_device``, :mod:`repic_tpu.solver.dual`) degrades through
+# the host rungs when its dual ascent fails to converge — the host
+# ladder stays reachable exactly as before.
 SOLVER_LADDER = {
     "exact": ("exact", "lp", "greedy"),
+    "lp_device": ("lp_device", "lp", "greedy"),
     "lp": ("lp", "greedy"),
     "greedy": ("greedy",),
 }
@@ -175,13 +181,20 @@ def solve_host_ladder(
         member_vertex: ``(C, K)`` int vertex ids (valid cliques only).
         w: ``(C,)`` weights.
         num_vertices: vertex-space size.
-        solver: requested rung (``exact``/``lp``/``greedy``).
+        solver: requested rung
+            (``lp_device``/``exact``/``lp``/``greedy``).
         budget_s: wall-clock budget for the exact rung; ``None`` =
             unbudgeted.  The node_limit budget applies either way.
 
     Returns:
         ``(picked, used)`` — bool mask over the C cliques and the
         rung that produced it.  ``used != solver`` means degradation.
+        A node-limit hit inside an unbudgeted exact solve no longer
+        passes silently: the per-component greedy fallback reports
+        as the ``exact_fallback`` rung (counted AND journaled by the
+        callers exactly like any other degradation).  The
+        ``lp_device`` rung degrades on real dual-ascent
+        non-convergence or an injected ``solver_diverge`` firing.
     """
     import numpy as np
 
@@ -211,13 +224,36 @@ def solve_host_ladder(
         if faults.check("solver_budget", rung):
             continue  # injected budget exhaustion of this rung
         try:
-            if rung == "exact":
+            if rung == "lp_device":
+                if faults.check("solver_diverge", rung):
+                    continue  # injected dual-ascent divergence
+                from repic_tpu.solver import solve_lp_device_host
+
+                picked, converged = solve_lp_device_host(
+                    member_vertex, w, num_vertices
+                )
+                if not converged:
+                    # budget exhausted with prices still moving:
+                    # degrade to the host rungs rather than hand
+                    # back an uncertified packing as this rung's
+                    continue
+            elif rung == "exact":
+                fallback_log: list = []
                 picked = solve_exact(
                     member_vertex,
                     w.astype(np.float64),
                     node_limit=node_limit,
                     budget_s=budget_s,
+                    fallback_log=fallback_log,
                 )
+                if fallback_log:
+                    # node-limit greedy fallback inside >= 1
+                    # component: the packing is NOT exact — surface
+                    # it as its own rung so the journal shows which
+                    # micrographs lost the exact rung (previously
+                    # only a process-wide counter moved)
+                    rung_total.inc(rung="exact_fallback")
+                    return picked, "exact_fallback"
             else:
                 picked = _solve_device(
                     solve_lp_rounding, member_vertex, w, num_vertices

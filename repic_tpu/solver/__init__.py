@@ -1,0 +1,29 @@
+"""On-device packing solvers (the ``lp_device`` rung).
+
+The batched dual-decomposition LP solver that retires the host
+solver ladder from the hot path: see :mod:`repic_tpu.solver.dual`
+for the algorithm and :mod:`repic_tpu.runtime.ladder` for how the
+host ladder stays reachable as its fallback.
+"""
+
+from repic_tpu.solver.dual import (
+    DEFAULT_NUM_ITERS,
+    DEFAULT_TOL,
+    DualSolveStats,
+    note_program_solves,
+    record_device_solve,
+    solve_dual_decomposition,
+    solve_lp_device,
+    solve_lp_device_host,
+)
+
+__all__ = [
+    "DEFAULT_NUM_ITERS",
+    "DEFAULT_TOL",
+    "DualSolveStats",
+    "note_program_solves",
+    "record_device_solve",
+    "solve_dual_decomposition",
+    "solve_lp_device",
+    "solve_lp_device_host",
+]

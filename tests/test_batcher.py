@@ -404,6 +404,67 @@ def test_burst_coalesces_across_requests(tmp_path):
         d2.drain()
 
 
+def test_coalesced_multi_tenant_chunk_solves_on_device(tmp_path):
+    """ISSUE 18 acceptance: a coalesced multi-tenant chunk's packings
+    solve INSIDE the fused device program (the lp_device rung) — the
+    in-program solve counter advances by the real micrograph count,
+    no trace carries a host-solve segment, and every request's
+    journal records the lp_device rung per micrograph (provenance
+    stays per-tenant even though the solve was shared)."""
+    from repic_tpu.runtime.journal import read_journal
+
+    dirs = [
+        make_picker_dir(tmp_path / f"t{i}", 2, seed=10 + i)
+        for i in range(3)
+    ]
+    wd = str(tmp_path / "wd")
+    dead = ConsensusDaemon(wd, warmup=False)
+    jobs = [
+        dead.queue.submit({
+            "in_dir": d, "box_size": 180,
+            "options": {"use_mesh": False},
+        })
+        for d in dirs
+    ]
+    dead.journal.close()
+    solves0 = _counter("repic_solver_device_solves_total")
+    d2 = ConsensusDaemon(wd, warmup=False).start()
+    try:
+        port = d2.server.port
+        for job in jobs:
+            doc = _wait_terminal(port, job.id)
+            assert doc["state"] == "finished", doc
+        # 3 tenants x 2 micrographs solved in-program, counted at
+        # the chunk settle (note_program_solves) — the happy path
+        # never fetches per-solve stats back to the host
+        assert (
+            _counter("repic_solver_device_solves_total") - solves0
+            >= 6
+        )
+        for job in jobs:
+            jd = d2.job_dir(job.id)
+            trace = [
+                json.loads(line)
+                for line in open(os.path.join(jd, "_trace.jsonl"))
+            ]
+            segs = {r.get("seg") for r in trace if "seg" in r}
+            assert "execute" in segs, trace
+            assert "host_solve" not in segs, (
+                "a host solver round trip ran on the lp_device "
+                "happy path"
+            )
+            latest = {
+                e["name"]: e
+                for e in read_journal(jd) if "name" in e
+            }
+            assert len(latest) == 2
+            for e in latest.values():
+                assert e["solver"] == "lp_device"
+                assert e["status"] == "ok"
+    finally:
+        d2.drain()
+
+
 def _spawn_cli_daemon(wd, extra=()):
     env = dict(
         os.environ,

@@ -1,0 +1,307 @@
+"""On-device dual-decomposition solver (the ``lp_device`` rung):
+feasibility property tests, padded-row inertness, vmap/jit parity,
+ladder degradation on (injected) dual-ascent divergence, and the
+directory pipeline's journaled host fallback — the ISSUE 18
+acceptance surface for ``repic_tpu/solver/``.
+"""
+
+import numpy as np
+import pytest
+
+from repic_tpu.runtime import faults
+from repic_tpu.runtime.ladder import solve_host_ladder
+from repic_tpu.solver import (
+    DEFAULT_NUM_ITERS,
+    solve_dual_decomposition,
+    solve_lp_device,
+    solve_lp_device_host,
+)
+
+
+def _instance(rng, C=40, K=3, n=24):
+    """A random packing instance with the pipeline's vid structure
+    (vid = member + picker_column * capacity, so ids within one
+    clique are always distinct)."""
+    member = rng.integers(0, n, size=(C, K))
+    vid = (member + np.arange(K)[None, :] * n).astype(np.int32)
+    w = rng.uniform(0.1, 3.0, C).astype(np.float32)
+    valid = rng.uniform(size=C) < 0.8
+    return vid, w, valid, K * n
+
+
+def _assert_feasible(vid, picked, valid):
+    picked = np.asarray(picked)
+    assert not np.any(picked & ~np.asarray(valid)), (
+        "picked a padded/invalid clique"
+    )
+    used = np.asarray(vid)[picked].ravel()
+    assert len(np.unique(used)) == used.size, (
+        "a particle vertex appears in two picked cliques"
+    )
+
+
+# ---- feasibility / quality properties -------------------------------
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_always_feasible_and_never_worse_than_greedy(seed):
+    import jax.numpy as jnp
+
+    from repic_tpu.ops.solver import solve_greedy
+
+    rng = np.random.default_rng(seed)
+    vid, w, valid, nv = _instance(rng)
+    picked = np.asarray(solve_lp_device(
+        jnp.asarray(vid), jnp.asarray(w), jnp.asarray(valid), nv
+    ))
+    _assert_feasible(vid, picked, valid)
+    greedy = np.asarray(solve_greedy(
+        jnp.asarray(vid), jnp.asarray(w), jnp.asarray(valid), nv
+    ))
+    assert w[picked].sum() >= w[greedy].sum() - 1e-5, (
+        "lp_device fell below the greedy floor"
+    )
+
+
+def test_padded_rows_are_inert():
+    """Appending invalid (padded) rows changes nothing: same picks on
+    the real rows, padding never picked."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    vid, w, valid, nv = _instance(rng, C=20)
+    base = np.asarray(solve_lp_device(
+        jnp.asarray(vid), jnp.asarray(w), jnp.asarray(valid), nv
+    ))
+    pad = 12
+    vid2 = np.concatenate([vid, np.zeros((pad, 3), np.int32)])
+    w2 = np.concatenate([w, np.full(pad, 99.0, np.float32)])
+    valid2 = np.concatenate([valid, np.zeros(pad, bool)])
+    out = np.asarray(solve_lp_device(
+        jnp.asarray(vid2), jnp.asarray(w2), jnp.asarray(valid2), nv
+    ))
+    assert not out[len(vid):].any(), "picked a padded row"
+    np.testing.assert_array_equal(out[: len(vid)], base)
+
+
+def test_empty_and_all_invalid_problems():
+    import jax.numpy as jnp
+
+    out = solve_dual_decomposition(
+        jnp.zeros((4, 3), jnp.int32),
+        jnp.zeros(4, jnp.float32),
+        jnp.zeros(4, bool),
+        12,
+    )
+    assert not np.asarray(out.picked).any()
+    # an all-padding lane converges immediately, not at the budget
+    assert int(out.iterations) < DEFAULT_NUM_ITERS
+    assert bool(out.converged)
+
+
+def test_stats_sane_on_easy_instance():
+    """A conflict-free instance: every clique picked, zero gap."""
+    import jax.numpy as jnp
+
+    vid = jnp.arange(12, dtype=jnp.int32).reshape(4, 3)
+    w = jnp.asarray([1.0, 2.0, 3.0, 4.0], jnp.float32)
+    out = solve_dual_decomposition(
+        vid, w, jnp.ones(4, bool), 12
+    )
+    assert np.asarray(out.picked).all()
+    assert bool(out.converged)
+    assert float(out.gap) < 1e-3
+    assert int(out.iterations) <= DEFAULT_NUM_ITERS
+
+
+# ---- jit / vmap parity ----------------------------------------------
+
+
+def test_jit_and_eager_agree():
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    vid, w, valid, nv = _instance(rng)
+    args = (jnp.asarray(vid), jnp.asarray(w), jnp.asarray(valid))
+    eager = solve_lp_device(*args, nv)
+    jitted = jax.jit(
+        solve_lp_device, static_argnums=(3,)
+    )(*args, nv)
+    np.testing.assert_array_equal(
+        np.asarray(eager), np.asarray(jitted)
+    )
+
+
+def test_vmap_matches_per_instance_loop():
+    """The batched (micrograph-axis) solve is bit-identical to
+    solving each lane alone — the property the fused chunk program
+    relies on."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(11)
+    insts = [_instance(rng, C=24) for _ in range(5)]
+    nv = insts[0][3]
+    vids = jnp.asarray(np.stack([i[0] for i in insts]))
+    ws = jnp.asarray(np.stack([i[1] for i in insts]))
+    valids = jnp.asarray(np.stack([i[2] for i in insts]))
+    batched = jax.vmap(
+        lambda v, w, m: solve_lp_device(v, w, m, nv)
+    )(vids, ws, valids)
+    for i, (vid, w, valid, _) in enumerate(insts):
+        solo = solve_lp_device(
+            jnp.asarray(vid), jnp.asarray(w), jnp.asarray(valid), nv
+        )
+        np.testing.assert_array_equal(
+            np.asarray(batched[i]), np.asarray(solo)
+        )
+        _assert_feasible(vid, np.asarray(batched[i]), valid)
+
+
+# ---- ladder integration ---------------------------------------------
+
+_MV = np.array([[0, 1], [1, 2], [2, 3], [3, 4]], np.int32)
+_W = np.array([2.0, 1.5, 1.0, 0.4], np.float32)
+
+
+@pytest.mark.faults
+def test_ladder_lp_device_rung_runs_and_counts():
+    from repic_tpu import telemetry
+
+    solves = telemetry.counter("repic_solver_device_solves_total")
+    before = solves.value()
+    picked, used = solve_host_ladder(_MV, _W, 5, solver="lp_device")
+    assert used == "lp_device"
+    np.testing.assert_array_equal(
+        picked, [True, False, True, False]
+    )
+    assert solves.value() == before + 1
+
+
+@pytest.mark.faults
+def test_injected_divergence_degrades_to_lp_then_greedy():
+    with faults.fault_plan("solver_diverge:lp_device:inf"):
+        picked, used = solve_host_ladder(
+            _MV, _W, 5, solver="lp_device"
+        )
+    assert used == "lp"
+    np.testing.assert_array_equal(
+        picked, [True, False, True, False]
+    )
+    with faults.fault_plan(
+        "solver_diverge:lp_device:inf", "solver_budget:lp:inf"
+    ):
+        picked, used = solve_host_ladder(
+            _MV, _W, 5, solver="lp_device"
+        )
+    assert used == "greedy"
+
+
+@pytest.mark.faults
+def test_node_limit_fallback_surfaces_as_exact_fallback_rung():
+    """Satellite 1: the silent per-component greedy fallback inside
+    an unbudgeted exact solve now reports as its own rung instead of
+    only bumping a process-wide counter."""
+    mv = np.array([[i, i + 1] for i in range(30)], np.int32)
+    w = np.linspace(1.0, 2.0, 30).astype(np.float32)
+    picked, used = solve_host_ladder(
+        mv, w, 31, solver="exact", node_limit=2
+    )
+    assert used == "exact_fallback"
+    assert picked.any()  # the greedy fallback still packs
+    # an unconstrained solve of the same instance stays exact
+    _, used = solve_host_ladder(mv, w, 31, solver="exact")
+    assert used == "exact"
+
+
+def test_host_wrapper_emits_telemetry():
+    from repic_tpu import telemetry
+
+    iters = telemetry.counter(
+        "repic_solver_device_iterations_total"
+    )
+    before = iters.value()
+    picked, converged = solve_lp_device_host(_MV, _W, 5)
+    assert converged
+    assert iters.value() > before
+
+
+# ---- directory pipeline: journaled divergence fallback --------------
+
+
+def _make_dir(tmp_path, m=4, k=3, n=24, seed=0):
+    rng = np.random.default_rng(seed)
+    d = tmp_path / "picks"
+    for p in range(k):
+        (d / f"picker{p}").mkdir(parents=True)
+    for i in range(m):
+        base = rng.uniform(50, 950, size=(n, 2))
+        for p in range(k):
+            jit = rng.normal(0, 10, size=base.shape)
+            conf = rng.uniform(0.1, 1.0, size=n)
+            with open(d / f"picker{p}" / f"mic{i}.box", "wt") as f:
+                for (x, y), c in zip(base + jit, conf):
+                    f.write(
+                        f"{x:.2f}\t{y:.2f}\t64\t64\t{c:.4f}\n"
+                    )
+    return str(d)
+
+
+@pytest.mark.faults
+def test_dir_run_journals_lp_device_rung_per_micrograph(tmp_path):
+    from repic_tpu.pipeline.consensus import run_consensus_dir
+    from repic_tpu.runtime.journal import read_journal
+
+    data = _make_dir(tmp_path)
+    out = str(tmp_path / "out")
+    stats = run_consensus_dir(data, out, 64, use_mesh=False)
+    assert sorted(stats["particle_counts"]) == [
+        f"mic{i}" for i in range(4)
+    ]
+    latest = {
+        e["name"]: e for e in read_journal(out) if "name" in e
+    }
+    for i in range(4):
+        assert latest[f"mic{i}"]["solver"] == "lp_device"
+        assert latest[f"mic{i}"]["status"] == "ok"
+
+
+@pytest.mark.faults
+def test_injected_divergence_journals_host_fallback(tmp_path):
+    """``solver_diverge:mic1`` makes exactly that micrograph's device
+    solve read as non-converged: it re-solves on the host ladder,
+    its journal entry carries the fallback rung + degraded status +
+    a ``solver_degraded`` event, and every other micrograph stays on
+    ``lp_device`` — with outputs still written for all."""
+    import os
+
+    from repic_tpu.pipeline.consensus import run_consensus_dir
+    from repic_tpu.runtime.journal import read_journal
+
+    data = _make_dir(tmp_path)
+    out = str(tmp_path / "out")
+    with faults.fault_plan("solver_diverge:mic1:1"):
+        stats = run_consensus_dir(data, out, 64, use_mesh=False)
+        assert ("solver_diverge", "mic1") in faults.fired_log()
+    assert sorted(stats["particle_counts"]) == [
+        f"mic{i}" for i in range(4)
+    ]
+    for i in range(4):
+        assert os.path.exists(os.path.join(out, f"mic{i}.box"))
+    latest = {
+        e["name"]: e for e in read_journal(out) if "name" in e
+    }
+    assert latest["mic1"]["solver"] in ("lp", "greedy")
+    assert latest["mic1"]["status"] == "degraded"
+    for i in (0, 2, 3):
+        assert latest[f"mic{i}"]["solver"] == "lp_device"
+        assert latest[f"mic{i}"]["status"] == "ok"
+    events = [
+        e for e in read_journal(out)
+        if e.get("event") == "solver_degraded"
+    ]
+    assert len(events) == 1
+    assert events[0]["micrograph"] == "mic1"
+    assert events[0]["rung"] == "lp_device"
+    assert events[0]["reason"] == "diverged"

@@ -14,7 +14,9 @@ three regimes —
 * the k=5 mixed-box-size ensemble (configs[4] shape, scaled).
 
 Full-scale (50k x 4) numbers are measured by bench_solver_quality.py
-and recorded in docs/tpu.md (artifact: SOLVER_QUALITY_r5.json).
+and recorded in docs/tpu.md (artifacts: SOLVER_QUALITY_r5.json /
+SOLVER_QUALITY_r6.json — r6 adds the on-device dual-decomposition
+``lp_device`` rung, gated here alongside greedy and lp).
 """
 
 import numpy as np
@@ -65,7 +67,7 @@ def _batch(xy, conf, mask, k):
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("solver", ["greedy", "lp"])
+@pytest.mark.parametrize("solver", ["greedy", "lp", "lp_device"])
 @pytest.mark.parametrize(
     "workload,jitter",
     [("stress", 10.0), ("stress_hard", 40.0)],
@@ -78,7 +80,7 @@ def test_stress_density_within_gate_of_exact(workload, jitter, solver):
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("solver", ["greedy", "lp"])
+@pytest.mark.parametrize("solver", ["greedy", "lp", "lp_device"])
 def test_k5_mixed_within_gate_of_exact(solver):
     xy, conf, mask, sizes = _mixed_synthesize(1, 4000, seed=11)
     ratio, jac = _quality(
