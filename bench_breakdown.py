@@ -234,6 +234,137 @@ def bench_headline(platform: str) -> dict:
     }
 
 
+def bench_probecheck(platform: str, reps: int = 5) -> dict:
+    """Packed-vs-separate transfer cross-check (ROADMAP carry-over).
+
+    The single-transfer output fusion (probe bits + BOX outputs in one
+    packed array) landed BETWEEN healthy TPU windows, so the chip has
+    never confirmed that the packed path carries exactly what the
+    separate probe fetch + per-array output fetches carried.  This
+    workload proves it on whatever backend it runs:
+
+    * every probe (max_adjacency, num_cliques, max_cell_count,
+      max_partial) read from the packed head row must equal the value
+      fetched directly from the result fields;
+    * the BOX-writer inputs (picked, rep_xy, confidence, rep_slot)
+      unpacked from the body must be bitwise equal to direct fetches;
+    * the rendered BOX bytes from both paths must be identical.
+
+    Timing: each rep re-executes the compiled program then fetches via
+    one path, so the packed-vs-separate delta measures the transfer
+    count (1 vs 5 round trips — invisible on CPU, ~4x RTT on the
+    tunneled chip).  Any mismatch makes the process exit non-zero via
+    the ``"match"`` field (the runbook greps for it).
+    """
+    import hashlib
+
+    from repic_tpu.parallel.batching import pad_batch
+    from repic_tpu.pipeline import consensus as C
+    from repic_tpu.utils import box_io
+
+    data = _examples_dir()
+    pickers = box_io.discover_picker_dirs(data)
+    names = box_io.micrograph_names(os.path.join(data, pickers[0]))
+    loaded = [
+        (n, box_io.load_micrograph_set(data, pickers, n)) for n in names
+    ]
+    batch = pad_batch([(n, s) for n, s in loaded if s is not None])
+
+    # BOTH transfer paths read the SAME result object: two separate
+    # executions could legally differ elementwise (the adaptive
+    # capacity cache may change max_neighbors between calls, which
+    # permutes clique buffer order while preserving the particle set)
+    # — that would test run-to-run determinism, not the transfer path.
+    _progress("probecheck: consensus run (packed fetch)")
+    res_p, packed = C.run_consensus_batch(
+        batch, 180.0, use_mesh=False, packed_probe=True
+    )
+    _progress("probecheck: separate fetch of the same result")
+    picked_s = np.asarray(res_p.picked)
+    rep_s = np.asarray(res_p.rep_xy, np.float32)
+    conf_s = np.asarray(res_p.confidence, np.float32)
+    slot_s = np.asarray(res_p.rep_slot)
+    m = picked_s.shape[0]
+    probes_s = np.stack(
+        [
+            np.broadcast_to(np.asarray(res_p.max_adjacency), (m,)),
+            np.broadcast_to(np.asarray(res_p.num_cliques), (m,)),
+            np.broadcast_to(np.asarray(res_p.max_cell_count), (m,)),
+            np.broadcast_to(np.asarray(res_p.max_partial), (m,)),
+        ],
+        axis=-1,
+    ).astype(np.int32)
+
+    picked_p, rep_p, conf_p, slot_p, _nc = C._unpack_box_outputs(packed)
+    probes_p = C._packed_probes(packed)
+
+    checks = {
+        "probes": bool(np.array_equal(probes_p, probes_s)),
+        "picked": bool(np.array_equal(picked_p, picked_s)),
+        "rep_xy": bool(
+            np.array_equal(
+                rep_p.astype(np.float32), rep_s, equal_nan=True
+            )
+        ),
+        "confidence": bool(
+            np.array_equal(
+                conf_p.astype(np.float32), conf_s, equal_nan=True
+            )
+        ),
+        "rep_slot": bool(np.array_equal(slot_p, slot_s)),
+    }
+
+    # rendered BOX bytes, both paths through the same renderer
+    def _digest_packed(pk):
+        h = hashlib.sha256()
+        C.emit_box_chunk(
+            batch, pk, 180.0,
+            sink=lambda f, c: h.update(f.encode() + c.encode()),
+        )
+        return h.hexdigest()
+
+    def _digest_separate():
+        h = hashlib.sha256()
+        for i, name in enumerate(batch.names):
+            if not name:
+                continue
+            sel = np.where(picked_s[i])[0]
+            content, _n = box_io.render_box(
+                rep_s[i, sel], conf_s[i, sel], 180.0
+            )
+            h.update((name + ".box").encode() + content.encode())
+        return h.hexdigest()
+
+    checks["box_bytes"] = _digest_packed(packed) == _digest_separate()
+
+    # transfer-path timing: re-execute + fetch per rep so neither path
+    # benefits from jax.Array's cached host copy
+    packed_ts, sep_ts = [], []
+    for _ in range(reps):
+        t0 = time.time()
+        r, pk = C.run_consensus_batch(
+            batch, 180.0, use_mesh=False, packed_probe=True
+        )
+        packed_ts.append(time.time() - t0)  # fetch is internal
+        t0 = time.time()
+        r = C.run_consensus_batch(batch, 180.0, use_mesh=False)
+        for a in (r.picked, r.rep_xy, r.confidence, r.rep_slot,
+                  r.num_cliques):
+            np.asarray(a)  # repic: noqa[RT004] — the fetch IS timed
+        sep_ts.append(time.time() - t0)
+
+    return {
+        "workload": "probecheck: packed vs separate transfer paths "
+        "(headline batch)",
+        "platform": platform,
+        "match": all(checks.values()),
+        "checks": checks,
+        "packed_path_s": round(float(np.median(packed_ts)), 5),
+        "separate_path_s": round(float(np.median(sep_ts)), 5),
+        "dispatch_rtt_s": round(_rtt_seconds(), 5),
+    }
+
+
 MIXED_SIZES = (180.0, 200.0, 220.0, 160.0, 180.0)  # k=5, configs[4]
 
 
@@ -409,7 +540,8 @@ def main():
     ap.add_argument(
         "--workloads",
         default="headline,stress,batch1024",
-        help="comma-separated subset of headline,stress,batch1024",
+        help="comma-separated subset of "
+        "headline,stress,batch1024,probecheck",
     )
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--m1024", type=int, default=1024)
@@ -445,10 +577,15 @@ def main():
             )
         elif wl == "batch1024":
             out = bench_batch1024(platform, m=args.m1024)
+        elif wl == "probecheck":
+            out = bench_probecheck(platform)
         else:
             print(f"unknown workload {wl!r}", file=sys.stderr)
             continue
         print(json.dumps(out), flush=True)
+        if out.get("match") is False:
+            print("probecheck MISMATCH", file=sys.stderr)
+            return 1
 
 
 if __name__ == "__main__":

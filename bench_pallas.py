@@ -109,6 +109,150 @@ def bench_one(n, d, reps, threshold=0.3, box=180.0):
     return row
 
 
+def bench_fused_chunk(m, n, k, reps, history=None, threshold_pct=10.0):
+    """Fused megakernel chunk program vs the staged chunk program.
+
+    Three questions, answered in order:
+
+    1. **Agreement** — with ``REPIC_TPU_MEGAKERNEL_FORCE=1`` (interpret
+       mode off-TPU) the fused program's result must be bitwise equal
+       to the staged program's on every field the BOX writer and
+       solver consume.  A disagreement makes the whole row
+       ``"agree": false`` and the process exit non-zero.
+    2. **Dispatch budget** — transfers per warm chunk counted via the
+       framework's own fetch counter; ``device_dispatches`` = 1
+       compute dispatch + the fetch count (the megakernel acceptance
+       bar is <= 3 per coalesced chunk).
+    3. **Throughput** — warm per-call seconds and micrographs/s for
+       both solver configs at PRODUCTION settings (no FORCE): on CPU
+       ``lp_device_fused`` statically demotes to the staged program
+       (same math, so CPU mic/s is no worse than staged by
+       construction and the timing is real); on TPU it runs the
+       actual kernel.  Emits one BENCH-shape row per config
+       (``metric``/``value``/``warm_total_s``/``first_call_s``) and,
+       with ``--history``, appends the fused row to the bench
+       trajectory and diffs fused vs staged via scripts/bench_compare.
+    """
+    import jax
+
+    from bench_stress import synthesize
+    from repic_tpu.parallel.batching import PaddedBatch
+    from repic_tpu.pipeline.consensus import run_consensus_batch
+    from repic_tpu.telemetry import probes as tlm_probes
+
+    platform = jax.default_backend()
+    xy, conf, mask = synthesize(m, k, n, seed=0)
+    batch = PaddedBatch(
+        xy=xy, conf=conf, mask=mask,
+        names=tuple(f"m{i}" for i in range(m)),
+        counts=np.full((m, k), n, np.int32),
+    )
+    box = 180.0
+
+    # 1. agreement: fused kernel (forced, interpret off-TPU) vs staged
+    res_staged = jax.device_get(
+        run_consensus_batch(batch, box, use_mesh=False, solver="lp_device")
+    )
+    prev = os.environ.get("REPIC_TPU_MEGAKERNEL_FORCE")
+    os.environ["REPIC_TPU_MEGAKERNEL_FORCE"] = "1"
+    try:
+        res_fused = jax.device_get(
+            run_consensus_batch(
+                batch, box, use_mesh=False, solver="lp_device_fused"
+            )
+        )
+    finally:
+        if prev is None:
+            os.environ.pop("REPIC_TPU_MEGAKERNEL_FORCE", None)
+        else:
+            os.environ["REPIC_TPU_MEGAKERNEL_FORCE"] = prev
+    # Padding rows past the compaction frontier carry whatever each
+    # program's scatter left there (different garbage, read by
+    # nothing): the contract is equality of the valid mask, the picks,
+    # and every field ON valid rows.
+    valid = np.asarray(res_staged.valid)
+    agree = np.array_equal(valid, np.asarray(res_fused.valid))
+    agree = agree and np.array_equal(
+        np.asarray(res_staged.picked), np.asarray(res_fused.picked)
+    )
+    for f in ("member_idx", "rep_slot", "w", "confidence", "rep_xy"):
+        a = np.asarray(getattr(res_staged, f))[valid]
+        b = np.asarray(getattr(res_fused, f))[valid]
+        agree = agree and np.array_equal(a, b)
+
+    def _measure(solver):
+        # first call in THIS config (trace + compile; the capacity
+        # config is shared across configs, as in production)
+        t0 = time.time()
+        run_consensus_batch(
+            batch, box, use_mesh=False, solver=solver, packed_probe=True
+        )
+        first_s = time.time() - t0
+        f0 = tlm_probes.counters()[2]
+        ts = []
+        for _ in range(reps):
+            t0 = time.time()
+            run_consensus_batch(
+                batch, box, use_mesh=False, solver=solver,
+                packed_probe=True,
+            )
+            ts.append(time.time() - t0)
+        fetches = (tlm_probes.counters()[2] - f0) / max(reps, 1)
+        warm_s = float(np.median(ts))
+        return {
+            "metric": f"chunk_program_{solver}",
+            "value": round(m / warm_s, 3),
+            "warm_total_s": round(warm_s, 5),
+            "first_call_s": round(first_s, 3),
+            "device_dispatches": round(1 + fetches, 1),
+            "platform": platform,
+            "micrographs": m,
+            "particles": n,
+            "pickers": k,
+        }
+
+    staged_row = _measure("lp_device")
+    fused_row = _measure("lp_device_fused")
+    staged_row["agree"] = fused_row["agree"] = agree
+    print(json.dumps(staged_row), flush=True)
+    print(json.dumps(fused_row), flush=True)
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "scripts")
+    )
+    import bench_compare
+
+    rows, regressions = bench_compare.compare(
+        staged_row, fused_row, threshold_pct
+    )
+    for r in rows:
+        flag = "  REGRESSION" if r["regressed"] else ""
+        print(
+            f"fused vs staged {r['field']:>14}: {r['baseline']:g} -> "
+            f"{r['current']:g} ({r['change_pct']:+.1f}%){flag}",
+            file=sys.stderr,
+        )
+    if history:
+        lines, _hist_reg = bench_compare.update_history(
+            history, fused_row, threshold_pct
+        )
+        for line in lines:
+            print(f"history {line}", file=sys.stderr)
+    if not agree:
+        print("fused-vs-staged DISAGREEMENT", file=sys.stderr)
+        return 1
+    # regression in warm time between the two configs is advisory on
+    # CPU (fused demotes to staged there — differences are noise) and
+    # a hard failure on the chip, where the fused kernel must not be
+    # slower than the staged chain it replaces
+    if regressions and platform == "tpu":
+        for msg in regressions:
+            print(f"fused-vs-staged {msg}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--sizes", default="1024,4096,16384")
@@ -116,6 +260,22 @@ def main():
     ap.add_argument("--reps", type=int, default=30)
     ap.add_argument("--cpu", action="store_true",
                     help="correctness smoke on CPU (interpret mode)")
+    ap.add_argument(
+        "--fused", action="store_true",
+        help="fused megakernel chunk program vs staged chunk program "
+        "(agreement + dispatch budget + throughput rows)",
+    )
+    ap.add_argument("--m", type=int, default=2,
+                    help="--fused: micrographs per chunk")
+    ap.add_argument("--n", type=int, default=2000,
+                    help="--fused: particles per picker")
+    ap.add_argument("--k", type=int, default=3,
+                    help="--fused: pickers")
+    ap.add_argument(
+        "--history", metavar="FILE", default=None,
+        help="--fused: append the fused row to this bench-trajectory "
+        "JSONL (BENCH_HISTORY.jsonl) via scripts/bench_compare",
+    )
     args = ap.parse_args()
 
     from bench import hold_chip_lock
@@ -126,6 +286,12 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    if args.fused:
+        return bench_fused_chunk(
+            args.m, args.n, args.k,
+            reps=min(args.reps, 10),
+            history=args.history,
+        )
     for n in [int(s) for s in args.sizes.split(",")]:
         for d in [int(s) for s in args.d.split(",")]:
             row = bench_one(n, d, args.reps)

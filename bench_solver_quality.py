@@ -47,7 +47,14 @@ import numpy as np
 from bench_stress import synthesize
 
 #: device backends measured against the exact oracle, in report order
-SOLVERS = ("greedy", "lp", "lp_device")
+#: (lp_device_fused = the megakernel chunk program when the config is
+#: inside the fused envelope — set REPIC_TPU_MEGAKERNEL_FORCE=1 to
+#: exercise the kernel path off-TPU via interpret mode; otherwise it
+#: statically demotes to the identical staged lp_device program)
+SOLVERS = ("greedy", "lp", "lp_device", "lp_device_fused")
+
+#: rungs whose packings are feasibility-checked and Jaccard-gated
+GATED = ("lp_device", "lp_device_fused")
 
 
 def _mixed_synthesize(m, n, seed=0):
@@ -135,19 +142,20 @@ def run_workload(name, m, n, seed):
             row[f"jaccard_{solver}"] = round(
                 len(reps & reps_exact) / len(union) if union else 1.0, 6
             )
-            if solver == "lp_device":
+            if solver in GATED:
                 memv = np.asarray(res[solver].member_idx[i])[rv]
                 vidv = memv + np.arange(k)[None, :] * batch.capacity
                 used = vidv[picked].ravel()
-                row["feasible_lp_device"] = bool(
+                row[f"feasible_{solver}"] = bool(
                     len(np.unique(used)) == used.size
                 )
         out["per_micrograph"].append(row)
 
     out["lp_device_solves_per_s"] = round(m / solve_s, 2)
-    out["feasible_lp_device"] = all(
-        r["feasible_lp_device"] for r in out["per_micrograph"]
-    )
+    for solver in GATED:
+        out[f"feasible_{solver}"] = all(
+            r[f"feasible_{solver}"] for r in out["per_micrograph"]
+        )
     for solver in SOLVERS:
         out[f"min_jaccard_{solver}"] = min(
             r[f"jaccard_{solver}"] for r in out["per_micrograph"]
@@ -171,9 +179,9 @@ def main():
     ap.add_argument("--out", help="append JSON lines to this artifact")
     ap.add_argument(
         "--gate", type=float, metavar="MIN_JACCARD",
-        help="CI gate: exit 1 when any workload's lp_device "
-        "min-Jaccard vs exact falls below this, or any lp_device "
-        "packing is infeasible",
+        help="CI gate: exit 1 when any workload's lp_device or "
+        "lp_device_fused min-Jaccard vs exact falls below this, or "
+        "any of their packings is infeasible",
     )
     ap.add_argument(
         "--device", action="store_true",
@@ -205,14 +213,15 @@ def main():
             with open(args.out, "at") as f:
                 f.write(line + "\n")
         if args.gate is not None:
-            if not out["feasible_lp_device"]:
-                failures.append(f"{out['workload']}: infeasible "
-                                "lp_device packing")
-            if out["min_jaccard_lp_device"] < args.gate:
-                failures.append(
-                    f"{out['workload']}: min_jaccard_lp_device "
-                    f"{out['min_jaccard_lp_device']} < {args.gate}"
-                )
+            for solver in GATED:
+                if not out[f"feasible_{solver}"]:
+                    failures.append(f"{out['workload']}: infeasible "
+                                    f"{solver} packing")
+                if out[f"min_jaccard_{solver}"] < args.gate:
+                    failures.append(
+                        f"{out['workload']}: min_jaccard_{solver} "
+                        f"{out[f'min_jaccard_{solver}']} < {args.gate}"
+                    )
     if failures:
         for msg in failures:
             print(f"GATE FAIL {msg}", file=sys.stderr)

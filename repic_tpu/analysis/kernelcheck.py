@@ -44,7 +44,10 @@ ENV_VAR = "REPIC_TPU_KERNELCHECK"
 
 #: modules imported at install time so their ``@checked`` kernel
 #: entries self-register before the registry sweep
-DEFAULT_MODULES = ("repic_tpu.ops.iou_pallas",)
+DEFAULT_MODULES = (
+    "repic_tpu.ops.iou_pallas",
+    "repic_tpu.ops.megakernel",
+)
 
 _installed = False
 _violations: list[dict] = []

@@ -77,12 +77,17 @@ def add_arguments(parser):
     )
     parser.add_argument(
         "--solver",
-        choices=["greedy", "lp", "lp_device", "exact"],
+        choices=["greedy", "lp", "lp_device", "lp_device_fused", "exact"],
         default="lp_device",
         help="packing backend: on-device dual-decomposition LP "
         "(lp_device, the default — solves inside the batched device "
         "program, degrading lp_device -> lp -> greedy on "
-        "non-convergence), parallel greedy dominance, LP relaxation "
+        "non-convergence), the fused megakernel chunk program "
+        "(lp_device_fused: IoU -> clique join -> LP solve as one "
+        "Pallas dispatch on TPU, statically demoting to the staged "
+        "lp_device program off-envelope or off-TPU; "
+        "REPIC_TPU_MEGAKERNEL_FORCE=1 forces interpret mode), "
+        "parallel greedy dominance, LP relaxation "
         "+ rounding, or the exact host-side branch-and-bound "
         "(degrades exact -> lp -> greedy under --solver_budget, "
         "recorded in the journal)",

@@ -1,0 +1,883 @@
+"""Pallas TPU megakernel: the fused coalesced-chunk program.
+
+The staged consensus chunk program is a chain of separately-lowered
+stages — IoU neighbor search, k-partite clique join, weight/
+representative extraction, buffer compaction, dual-decomposition LP
+solve — each of which round-trips its output through HBM between XLA
+kernels, and each of which the round-5 breakdown shows is
+dispatch/RTT-bound rather than compute-bound (76 ms of dispatch RTT
+against 114 ms of device exec on the headline).  This module collapses
+the chain, in the MPK mold (arXiv:2512.22219), into TWO Pallas
+programs per micrograph inside one jit:
+
+* :func:`fused_clique_candidates` — per (8, 128)-tile-aligned anchor
+  tile: box-IoU against every other picker's full particle row,
+  running top-D neighbor selection (D select-max passes with the
+  min-position tie-break of ``ops/iou_pallas.py``), the D^(K-1)
+  candidate product with cross-edge validation, median confidence /
+  weight / weighted-degree representative extraction, and stream
+  compaction into the bounded clique buffer — all in VMEM.  The
+  ``(N, N)`` IoU matrices and the ``(N, D^(K-1))`` clique candidate
+  tensor never materialize in HBM.
+* :func:`fused_dual_solve` — the PR 18 dual-decomposition LP solve
+  (:func:`repic_tpu.solver.dual.solve_dual_decomposition`, verbatim:
+  the solver is pure ``lax``/``jnp`` and runs unchanged inside the
+  kernel body) with the dual multipliers living in VMEM for the whole
+  ascent.
+
+Both wrappers sit inside one jitted ``consensus_one`` trace, so one
+coalesced chunk costs ONE device dispatch plus the packed-output
+fetch — within the <= 3-dispatch budget, versus the staged chain's
+per-stage kernel boundary crossings.
+
+Ordering contract (byte-identity with the staged path): survivors
+are stream-compacted in PRODUCT order (anchor-major, meshgrid-"ij"
+within an anchor — the exact buffer order of
+``cliques._assemble_block``), each carrying its product id ``pid``.
+That is the same valid-row relative order as both staged regimes:
+the full-product buffer trivially (position == pid), and the
+anchor-chunked path by design (its compaction is by index, not
+weight — cliques.py's escalation contract).  Identical valid-row
+values in identical relative order means the dual solve sees the
+same problem with the same greedy tie-breaking and the BOX emitter
+walks picked rows in the same sequence — bitwise-equal output, ties
+included, whenever nothing is dropped (the accepted-capacity
+escalation contract; on overflow the kernel keeps the LOWEST pids
+where the weight-sorted ``compact_cliques`` helper would keep the
+heaviest — overflow always re-escalates, so no accepted config ever
+sees the difference).
+
+Eligibility: the fused program covers the dense all-pairs path
+(``spatial_grid is None``) for ``2 <= K <= 6``, ``N <=``
+:data:`_FUSED_MAX_N` and ``D^(K-1) <=`` :data:`_FUSED_MAX_DPROD` —
+the serving capacity buckets.  Outside that envelope (or on CPU,
+where the staged XLA program is already one fused dispatch and
+interpret mode would only slow it down) ``consensus_one`` runs the
+staged pipeline with the same ``lp_device`` solve — the static
+fallback rung; the ``megakernel_fallback`` fault site
+(docs/robustness.md) exercises the dynamic demotion.
+
+Everything is CPU-verifiable through Pallas interpret mode (the
+KERNELCHECK differential probes and the golden tests force
+``interpret=True``); compiled TPU execution is probe-gated on the
+next healthy tunnel window, with the kernel body's gathers/medians
+flagged in docs/tpu.md as the Mosaic-lowering risk the fallback rung
+covers.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from repic_tpu import telemetry
+from repic_tpu.analysis.contracts import Contract, checked, spec
+from repic_tpu.analysis.kernels import (
+    BlockPlan,
+    KernelContract,
+    KernelPlan,
+)
+from repic_tpu.ops.cliques import CliqueSet, _edge_pairs
+
+LANE = 128   # TPU lane width; trailing block dims align to this
+KP = 8       # picker rows padded to one sublane tile
+NEG = -1.0   # select-max mask-out sentinel (any IoU is >= 0)
+
+# Fused-program eligibility envelope: the candidate product is
+# evaluated per anchor tile entirely in VMEM, so its lane width
+# D^(K-1) and the full-row candidate blocks bound what fits.  At the
+# caps, one tile's transient is TA x DPROD x ~(E + 2K + 4) f32
+# = 64 x 4096 x ~17 x 4 B ~= 18 MB of scoped liveness at K=4 — the
+# VMEM budget math in docs/tpu.md; past it the staged path wins.
+_FUSED_MAX_DPROD = 4096
+_FUSED_MAX_N = 8192
+_FUSED_MAX_K = 6
+
+_DEFAULT_TILE_A = 64
+
+#: env var forcing the kernel path on non-TPU backends (interpret
+#: mode) — the golden byte-identity tests and operator smoke use it;
+#: production CPU runs stay on the staged program (same math, no
+#: interpret overhead).
+FORCE_ENV = "REPIC_TPU_MEGAKERNEL_FORCE"
+
+_PROGRAMS = telemetry.counter(
+    "repic_megakernel_programs_total",
+    "coalesced chunks executed by the fused megakernel program",
+)
+_DISPATCHES_AVOIDED = telemetry.counter(
+    "repic_megakernel_dispatches_avoided_total",
+    "separately-dispatched stage boundaries (neighbor search, clique "
+    "join, compaction, solve -> one fused program) avoided by "
+    "megakernel chunks",
+)
+_FALLBACKS = telemetry.counter(
+    "repic_megakernel_fallbacks_total",
+    "chunks demoted from the fused megakernel to the staged rung",
+)
+
+#: stage boundaries of the staged chain that the fused program folds
+#: away per chunk (neighbor search | join | compaction | solve -> 1)
+STAGED_CHAIN_STAGES = 4
+
+
+def fused_eligible(
+    k: int, n: int, max_neighbors: int, *, spatial_grid=None
+) -> bool:
+    """Static envelope check: can the fused program run this config?"""
+    d = min(max_neighbors, n)
+    return (
+        spatial_grid is None
+        and 2 <= k <= _FUSED_MAX_K
+        and 1 <= n <= _FUSED_MAX_N
+        and d ** (k - 1) <= _FUSED_MAX_DPROD
+    )
+
+
+def kernel_requested() -> bool:
+    """True when the Pallas kernel path should execute: on a TPU
+    backend, or forced via ``REPIC_TPU_MEGAKERNEL_FORCE=1`` (tests /
+    operator smoke run interpret mode on CPU)."""
+    if os.environ.get(FORCE_ENV, "").strip() in ("1", "true", "yes"):
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def use_fused_kernel(
+    k: int, n: int, max_neighbors: int, *, spatial_grid=None
+) -> bool:
+    """Eligibility AND backend request — the consensus_one dispatch."""
+    return (
+        fused_eligible(k, n, max_neighbors, spatial_grid=spatial_grid)
+        and kernel_requested()
+    )
+
+
+def note_fused_chunk(n_micrographs: int) -> None:
+    """Host-boundary telemetry for one fused-program chunk."""
+    _PROGRAMS.inc()
+    if n_micrographs > 0:
+        _DISPATCHES_AVOIDED.inc(STAGED_CHAIN_STAGES - 1)
+
+
+def note_fallback(reason: str) -> None:
+    """Count one chunk demoted off the fused rung."""
+    _FALLBACKS.inc(reason=reason)
+
+
+# -- the fused clique-candidate kernel --------------------------------
+
+
+def _clique_kernel(
+    size_ref, a_ref, xs_ref, ys_ref, cf_ref, mk_ref,
+    mf_ref, mi_ref, pr_ref,
+    *, k: int, d: int, ta: int, cap: int, threshold: float,
+):
+    """One anchor tile's full candidate pipeline, state in VMEM.
+
+    Grid is the sequential anchor-tile axis; every output block is
+    revisited (indexed (0, 0)) so the clique buffer, the running
+    valid count, and the adjacency probe accumulate across steps —
+    the same revisited-output idiom as ``iou_pallas``.
+
+    Output layout (lane dim = padded clique buffer ``CP``):
+      * ``mf_ref`` (8, CP) f32 — rows 0..4: w, confidence, rep_x,
+        rep_y, stored-valid flag.
+      * ``mi_ref`` (8, CP) int32 — rows 0..K-1: member indices per
+        picker slot; row 6: rep_slot; row 7: product id ``pid``.
+      * ``pr_ref`` (8, LANE) int32 — [0, 0]: running TRUE valid count
+        (the ``num_valid`` escalation probe, pre-drop); [0, 1]:
+        max adjacency.
+    """
+    i = pl.program_id(0)
+    dprod = d ** (k - 1)
+
+    @pl.when(i == 0)
+    def _init():
+        mf_ref[:] = jnp.zeros(mf_ref.shape, mf_ref.dtype)
+        mi_ref[:] = jnp.zeros(mi_ref.shape, mi_ref.dtype)
+        pr_ref[:] = jnp.zeros(pr_ref.shape, pr_ref.dtype)
+
+    ax = a_ref[:, 0:1]               # (TA, 1) anchor lanes of the
+    ay = a_ref[:, 1:2]               # packed (TA, 128) block
+    am = a_ref[:, 2:3]
+    ac = a_ref[:, 3:4]
+    xsr = xs_ref[:]                  # (KP, NP) full candidate rows
+    ysr = ys_ref[:]
+    cfr = cf_ref[:]
+    mkr = mk_ref[:]
+    np_total = xsr.shape[1]
+    sa = size_ref[0]
+
+    # --- stage 1: IoU tile + running top-D per non-anchor picker.
+    # Masked entries are 0.0 (the staged pairwise_iou_matrix
+    # convention, NOT iou_pallas's NEG: byte-identity with the
+    # staged XLA path requires its zero-IoU tie classes verbatim,
+    # and padded candidates sit past every real index so the
+    # min-position tie-break never selects them over a real zero).
+    pos = jax.lax.broadcasted_iota(jnp.int32, (ta, np_total), 1)
+    lane_d = jax.lax.broadcasted_iota(jnp.int32, (ta, d), 1)
+    nbr_v, nbr_i = [], []
+    adj_max = jnp.zeros((), jnp.int32)
+    for p in range(1, k):
+        sb = size_ref[p]
+        bx = xsr[p:p + 1, :]         # (1, NP)
+        by = ysr[p:p + 1, :]
+        bm = mkr[p:p + 1, :]
+        ovx = jnp.maximum(
+            jnp.minimum(ax + sa, bx + sb) - jnp.maximum(ax, bx), 0.0
+        )
+        ovy = jnp.maximum(
+            jnp.minimum(ay + sa, by + sb) - jnp.maximum(ay, by), 0.0
+        )
+        inter = ovx * ovy
+        iou = inter / (sa * sa + sb * sb - inter)
+        iou = jnp.where((am > 0.0) & (bm > 0.0), iou, 0.0)  # (TA, NP)
+        adj_max = jnp.maximum(
+            adj_max,
+            jnp.max(
+                jnp.sum(
+                    (iou > threshold).astype(jnp.int32),
+                    axis=1, keepdims=True,
+                )
+            ),
+        )
+
+        def _pass(s, carry):
+            work_v, out_v, out_i = carry
+            row_max = jnp.max(work_v, axis=1, keepdims=True)
+            # first position among the row maxima: min-position
+            # reduction == lax.top_k's lower-index-first tie-break
+            first = jnp.min(
+                jnp.where(work_v == row_max, pos, np_total),
+                axis=1, keepdims=True,
+            )
+            out_v = jnp.where(lane_d == s, row_max, out_v)
+            out_i = jnp.where(lane_d == s, first, out_i)
+            work_v = jnp.where(pos == first, NEG, work_v)
+            return work_v, out_v, out_i
+
+        _, out_v, out_i = jax.lax.fori_loop(
+            0, d, _pass,
+            (
+                iou,
+                jnp.zeros((ta, d), iou.dtype),
+                jnp.zeros((ta, d), jnp.int32),
+            ),
+        )
+        nbr_v.append(out_v)          # (TA, D) top-D values
+        nbr_i.append(out_i)          # (TA, D) top-D indices (< N)
+
+    # --- stage 2: D^(K-1) candidate product (the _assemble_block
+    # math verbatim, per anchor tile instead of per micrograph).
+    # The meshgrid-"ij" selector of slot s is arithmetic on the
+    # product lane id — (lane // d^(k-2-s)) % d — built from an iota
+    # rather than a captured index-array constant (Pallas kernels
+    # take refs, not closed-over arrays).
+    lane_p = jax.lax.broadcasted_iota(jnp.int32, (ta, dprod), 1)
+    sels = [
+        (lane_p // (d ** (k - 2 - s))) % d for s in range(k - 1)
+    ]
+    aid = i * ta + jax.lax.broadcasted_iota(jnp.int32, (ta, 1), 0)
+    members = [jnp.broadcast_to(aid, (ta, dprod))]
+    member_ok = jnp.broadcast_to(am > 0.0, (ta, dprod))
+    for s in range(k - 1):
+        m_s = jnp.take_along_axis(nbr_i[s], sels[s], axis=1)
+        members.append(m_s)                           # (TA, DPROD)
+        member_ok = member_ok & (jnp.take(mkr[s + 1], m_s) > 0.0)
+
+    mx = [jnp.broadcast_to(ax, (ta, dprod))]
+    my = [jnp.broadcast_to(ay, (ta, dprod))]
+    for s in range(k - 1):
+        mx.append(jnp.take(xsr[s + 1], members[s + 1]))
+        my.append(jnp.take(ysr[s + 1], members[s + 1]))
+
+    edge_vals = []
+    for p, q in _edge_pairs(k):
+        if p == 0:
+            edge_vals.append(
+                jnp.take_along_axis(nbr_v[q - 1], sels[q - 1], axis=1)
+            )
+        else:
+            sb_p, sb_q = size_ref[p], size_ref[q]
+            ovx = jnp.maximum(
+                jnp.minimum(mx[p] + sb_p, mx[q] + sb_q)
+                - jnp.maximum(mx[p], mx[q]),
+                0.0,
+            )
+            ovy = jnp.maximum(
+                jnp.minimum(my[p] + sb_p, my[q] + sb_q)
+                - jnp.maximum(my[p], my[q]),
+                0.0,
+            )
+            inter = ovx * ovy
+            e = inter / (sb_p * sb_p + sb_q * sb_q - inter)
+            edge_vals.append(jnp.where(member_ok, e, 0.0))
+    edges = jnp.stack(edge_vals)                      # (E, TA, DPROD)
+    validt = member_ok & jnp.all(edges > threshold, axis=0)
+
+    confs = jnp.stack(
+        [jnp.broadcast_to(ac, (ta, dprod))]
+        + [
+            jnp.take(cfr[s + 1], members[s + 1])
+            for s in range(k - 1)
+        ]
+    )                                                 # (K, TA, DPROD)
+    confidence = jnp.median(confs, axis=0)
+    edge_med = jnp.median(edges, axis=0)
+    wgt = jnp.where(validt, confidence * edge_med, 0.0)
+    confidence = jnp.where(validt, confidence, 0.0)
+
+    degs = []
+    for k_slot in range(k):
+        incident = [
+            edges[e]
+            for e, (p, q) in enumerate(_edge_pairs(k))
+            if p == k_slot or q == k_slot
+        ]
+        degs.append(sum(incident))
+    deg = jnp.stack(degs)                             # (K, TA, DPROD)
+    # first-max tie-break built explicitly (min slot among the
+    # maxima): jnp.argmax's Mosaic tie-break differs from interpret
+    # mode's, and at K=2 BOTH slots are incident to the single edge —
+    # the tie is universal, not rare
+    deg_max = jnp.max(deg, axis=0)
+    slot_iota = jax.lax.broadcasted_iota(jnp.int32, deg.shape, 0)
+    rep_slot = jnp.min(
+        jnp.where(deg == deg_max, slot_iota, k), axis=0
+    )
+    member_stack = jnp.stack(members)                 # (K, TA, DPROD)
+    rep_particle = jnp.take_along_axis(
+        member_stack, rep_slot[None], axis=0
+    )[0]
+    flat_rep = rep_slot * np_total + rep_particle
+    rep_x = jnp.take(xsr.reshape(-1), flat_rep)
+    rep_y = jnp.take(ysr.reshape(-1), flat_rep)
+
+    # --- stage 3: stream-compact survivors into the clique buffer
+    # in product order, running count in the revisited probe block.
+    pid = aid * dprod + jax.lax.broadcasted_iota(
+        jnp.int32, (ta, dprod), 1
+    )
+    valid_flat = validt.reshape(ta * dprod)
+    cnt0 = pr_ref[0, 0]
+    cpos = cnt0 + jnp.cumsum(valid_flat.astype(jnp.int32)) - 1
+    ok = valid_flat & (cpos < cap)
+    tgt = jnp.where(ok, cpos, cap)    # slot `cap` is the trash slot
+    okf = ok.astype(mf_ref.dtype)
+    mf_rows = jnp.stack([
+        wgt.reshape(-1) * okf,
+        confidence.reshape(-1) * okf,
+        rep_x.reshape(-1) * okf,
+        rep_y.reshape(-1) * okf,
+        okf,
+        jnp.zeros_like(okf),
+        jnp.zeros_like(okf),
+        jnp.zeros_like(okf),
+    ])
+    oki = ok.astype(jnp.int32)
+    mi_members = [m.reshape(-1) * oki for m in members]
+    mi_rows = jnp.stack(
+        mi_members
+        + [jnp.zeros_like(oki)] * (6 - k)
+        + [rep_slot.reshape(-1) * oki, pid.reshape(-1) * oki]
+    )
+    mf_ref[:] = mf_ref[:].at[:, tgt].set(mf_rows)
+    mi_ref[:] = mi_ref[:].at[:, tgt].set(mi_rows)
+    pr = pr_ref[:]
+    pr = pr.at[0, 0].set(cnt0 + jnp.sum(valid_flat.astype(jnp.int32)))
+    pr = pr.at[0, 1].set(jnp.maximum(pr[0, 1], adj_max))
+    pr_ref[:] = pr
+
+
+def _candidate_dims(n: int, k: int, max_neighbors: int,
+                    clique_capacity: int, tile_a: int):
+    """The wrapper's tiling math, shared verbatim with ``_plan``."""
+    d = min(max_neighbors, n)
+    dprod = d ** (k - 1)
+    cap = min(clique_capacity, n * dprod)
+    np_ = n + (-n % LANE)
+    ta = 8
+    while ta * 2 <= min(tile_a, LANE, np_):
+        ta *= 2                      # power of two <= 128: divides NP
+    cp = (cap + 1) + (-(cap + 1) % LANE)
+    return d, dprod, cap, np_, ta, cp
+
+
+# -- contract (RT42x + KERNELCHECK) -----------------------------------
+
+_PROBE_D = 4
+_PROBE_CAP = 1024
+_PROBE_TILE_A = 64
+_PROBE_BOX = 180.0
+_PROBE_THRESHOLD = 0.3
+
+
+def _plan(dims: dict) -> KernelPlan:
+    n, k = dims["N"], dims["K"]
+    d, dprod, cap, np_, ta, cp = _candidate_dims(
+        n, k, _PROBE_D, _PROBE_CAP, _PROBE_TILE_A
+    )
+    full = lambda i: (0, 0)  # noqa: E731 — revisited/full blocks
+    return KernelPlan(
+        grid=(np_ // ta,),
+        in_blocks=(
+            BlockPlan("sizes", None, None, (KP,), memory_space="smem"),
+            BlockPlan(
+                "a_pack", (ta, LANE), lambda i: (i, 0), (np_, LANE)
+            ),
+            BlockPlan("xs", (KP, np_), full, (KP, np_)),
+            BlockPlan("ys", (KP, np_), full, (KP, np_)),
+            BlockPlan("cf", (KP, np_), full, (KP, np_)),
+            BlockPlan("mk", (KP, np_), full, (KP, np_)),
+        ),
+        out_blocks=(
+            BlockPlan("mf", (KP, cp), full, (KP, cp)),
+            BlockPlan("mi", (KP, cp), full, (KP, cp), dtype="int32"),
+            BlockPlan(
+                "pr", (KP, LANE), full, (KP, LANE), dtype="int32"
+            ),
+        ),
+    )
+
+
+def _probe_inputs(dims: dict):
+    import numpy as np
+
+    n, k = dims["N"], dims["K"]
+    rng = np.random.default_rng(1000 * k + n)
+    # clustered fields so real cliques (and weight ties at zero) form
+    base = rng.uniform(0, 1500.0, (n, 2))
+    xy = jnp.asarray(
+        base[None] + rng.normal(0, 25.0, (k, n, 2)), jnp.float32
+    )
+    conf = jnp.asarray(rng.uniform(0.5, 1.0, (k, n)), jnp.float32)
+    mask = jnp.asarray(rng.uniform(size=(k, n)) > 0.15)
+    return (xy, conf, mask, _PROBE_BOX), {}
+
+
+def _reference(xy, conf, mask, box_size):
+    """Ground truth: the staged full-product path this kernel fuses
+    away, index-order compacted to the kernel's buffer width (the
+    chunked path's compaction discipline — pid-ascending, never
+    weight-sorted)."""
+    from repic_tpu.ops.cliques import enumerate_cliques
+
+    n = xy.shape[1]
+    d = min(_PROBE_D, n)
+    dprod = d ** (xy.shape[0] - 1)
+    cap = min(_PROBE_CAP, n * dprod)
+    cs = enumerate_cliques(
+        xy, conf, mask, box_size,
+        threshold=_PROBE_THRESHOLD, max_neighbors=_PROBE_D,
+    )
+    length = cs.valid.shape[0]         # full product: position == pid
+    posn = jnp.where(cs.valid, jnp.arange(length), length)
+    order = jnp.argsort(posn)[:cap]    # valid rows first, pid asc
+    return (
+        cs.member_idx[order], cs.valid[order], cs.w[order],
+        cs.confidence[order], cs.rep_slot[order], cs.rep_xy[order],
+        order.astype(jnp.int32), cs.num_valid, cs.max_adjacency,
+    )
+
+
+def _compare(got, want, tol):
+    """Exact equality on valid rows (same ops on same values in
+    interpret mode) + the escalation probes; invalid slots carry
+    path-specific garbage on both sides and are skipped."""
+    import numpy as np
+
+    (g_mem, g_val, g_w, g_cf, g_slot, g_xy, g_pid, g_nv, g_adj) = got
+    (r_mem, r_val, r_w, r_cf, r_slot, r_xy, r_pid, r_nv, r_adj) = want
+    msgs = []
+    g_val, r_val = np.asarray(g_val), np.asarray(r_val)
+    if int(np.asarray(g_nv)) != int(np.asarray(r_nv)):
+        msgs.append(
+            f"num_valid: kernel {int(np.asarray(g_nv))} vs reference "
+            f"{int(np.asarray(r_nv))}"
+        )
+    if int(np.asarray(g_adj)) != int(np.asarray(r_adj)):
+        msgs.append(
+            f"max_adjacency: kernel {int(np.asarray(g_adj))} vs "
+            f"reference {int(np.asarray(r_adj))}"
+        )
+    if not np.array_equal(g_val, r_val):
+        msgs.append(
+            f"valid mask differs on "
+            f"{int(np.sum(g_val != r_val))} slot(s)"
+        )
+        return msgs
+    v = g_val
+    for name, g, r in (
+        ("member_idx", g_mem, r_mem),
+        ("w", g_w, r_w),
+        ("confidence", g_cf, r_cf),
+        ("rep_slot", g_slot, r_slot),
+        ("rep_xy", g_xy, r_xy),
+        ("pid", g_pid, r_pid),
+    ):
+        g, r = np.asarray(g)[v], np.asarray(r)[v]
+        if not np.array_equal(g, r):
+            bad = int(np.sum(np.any(np.atleast_2d(g != r), axis=-1)))
+            msgs.append(f"{name}: {bad} valid row(s) differ")
+    return msgs
+
+
+@checked(Contract(
+    args={
+        "xy": spec("K N 2"),
+        "conf": spec("K N"),
+        "mask": spec("K N", "bool"),
+        "box_size": spec(""),
+    },
+    returns=(
+        spec("C K", "int32"), spec("C", "bool"), spec("C"),
+        spec("C"), spec("C", "int32"), spec("C 2"),
+        spec("C", "int32"), spec("", "int32"), spec("", "int32"),
+    ),
+    dims={"K": 3, "N": 8, "C": 128},
+    static={
+        "threshold": _PROBE_THRESHOLD,
+        "max_neighbors": _PROBE_D,
+        "clique_capacity": _PROBE_CAP,
+        "tile_a": _PROBE_TILE_A,
+        "interpret": True,
+    },
+    kernel=KernelContract(
+        plan=_plan,
+        # bucket-aligned rungs plus ragged ones (padding exercised),
+        # across picker counts (K=2 degenerates the product join)
+        ladder=(
+            {"K": 3, "N": 64},
+            {"K": 3, "N": 96},
+            {"K": 2, "N": 40},
+            {"K": 4, "N": 24},
+        ),
+        make_inputs=_probe_inputs,
+        reference=_reference,
+        compare=_compare,
+        tol=0.0,
+    ),
+))
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "threshold", "max_neighbors", "clique_capacity", "tile_a",
+        "interpret",
+    ),
+)
+def fused_clique_candidates(
+    xy: jax.Array,
+    conf: jax.Array,
+    mask: jax.Array,
+    box_size,
+    *,
+    threshold: float = 0.3,
+    max_neighbors: int = 16,
+    clique_capacity: int = 4096,
+    tile_a: int = _DEFAULT_TILE_A,
+    interpret: bool = False,
+):
+    """Fused IoU -> top-D -> clique join -> stats -> compaction.
+
+    Args:
+        xy/conf/mask: ``(K, N, 2)`` / ``(K, N)`` padded picker rows
+            (the ``consensus_one`` layout).
+        box_size: scalar or ``(K,)`` per-picker box edge lengths.
+
+    Returns:
+        ``(member_idx, valid, w, confidence, rep_slot, rep_xy, pid,
+        num_valid, max_adjacency)`` with clique buffer width
+        ``C = min(clique_capacity, N * D^(K-1))``; valid rows occupy
+        the leading slots in product (pid-ascending) order — the
+        staged paths' valid-row order — and invalid slots are zeros.
+        ``num_valid`` is the TRUE valid count (pre-drop): the
+        escalation probe.
+    """
+    k, n, _ = xy.shape
+    if not 2 <= k <= _FUSED_MAX_K:
+        raise ValueError(
+            f"fused clique kernel supports 2 <= K <= {_FUSED_MAX_K}, "
+            f"got K={k}"
+        )
+    d, dprod, cap, np_, ta, cp = _candidate_dims(
+        n, k, max_neighbors, clique_capacity, tile_a
+    )
+    if dprod > _FUSED_MAX_DPROD:
+        raise ValueError(
+            f"candidate product D^(K-1)={dprod} exceeds the fused "
+            f"VMEM envelope ({_FUSED_MAX_DPROD}); use the staged path"
+        )
+    dtype = xy.dtype
+    sizes = jnp.broadcast_to(
+        jnp.asarray(box_size, dtype).reshape(-1), (k,)
+    )
+    sizes = jnp.pad(sizes, (0, KP - k))
+    n_pad = np_ - n
+    maskf = mask.astype(dtype)
+    a_pack = jnp.stack(
+        [
+            jnp.pad(xy[0, :, 0], (0, n_pad)),
+            jnp.pad(xy[0, :, 1], (0, n_pad)),
+            jnp.pad(maskf[0], (0, n_pad)),
+            jnp.pad(conf[0], (0, n_pad)),
+        ],
+        axis=1,
+    )
+    a_pack = jnp.pad(a_pack, ((0, 0), (0, LANE - 4)))
+    row_pad = ((0, KP - k), (0, n_pad))
+    xs = jnp.pad(xy[:, :, 0], row_pad)
+    ys = jnp.pad(xy[:, :, 1], row_pad)
+    cf = jnp.pad(conf, row_pad)
+    mk = jnp.pad(maskf, row_pad)
+
+    kernel = functools.partial(
+        _clique_kernel,
+        k=k, d=d, ta=ta, cap=cap,
+        threshold=float(threshold),
+    )
+    from jax.experimental.pallas import tpu as pltpu
+
+    full = lambda i: (0, 0)  # noqa: E731
+    mf, mi, pr = pl.pallas_call(
+        kernel,
+        grid=(np_ // ta,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((ta, LANE), lambda i: (i, 0)),
+            pl.BlockSpec((KP, np_), full),
+            pl.BlockSpec((KP, np_), full),
+            pl.BlockSpec((KP, np_), full),
+            pl.BlockSpec((KP, np_), full),
+        ],
+        out_specs=[
+            pl.BlockSpec((KP, cp), full),
+            pl.BlockSpec((KP, cp), full),
+            pl.BlockSpec((KP, LANE), full),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((KP, cp), dtype),
+            jax.ShapeDtypeStruct((KP, cp), jnp.int32),
+            jax.ShapeDtypeStruct((KP, LANE), jnp.int32),
+        ],
+        interpret=interpret,
+    )(sizes, a_pack, xs, ys, cf, mk)
+
+    # The kernel's stream compaction already leaves valid rows at
+    # slots [0, min(num_valid, C)) in product (pid-ascending) order —
+    # the staged paths' valid-row order — so the epilogue is pure
+    # slicing: no sort, no gather.
+    member_idx = jnp.transpose(mi[:k, :cap])          # (C, K)
+    valid = mf[4, :cap] > 0.0
+    w = mf[0, :cap]
+    confidence = mf[1, :cap]
+    rep_xy = jnp.stack([mf[2, :cap], mf[3, :cap]], axis=-1)
+    rep_slot = mi[6, :cap]
+    pid = mi[7, :cap]
+    num_valid = pr[0, 0]
+    max_adjacency = pr[0, 1]
+    return (
+        member_idx, valid, w, confidence, rep_slot, rep_xy,
+        pid, num_valid, max_adjacency,
+    )
+
+
+# -- the fused dual-decomposition solve kernel ------------------------
+
+
+def _solve_kernel(
+    vid_ref, w_ref, v_ref, p_ref,
+    *, k: int, num_vertices: int, num_iters: int, tol: float,
+):
+    """The PR 18 dual-ascent LP solve inside one Pallas program: the
+    price vector, the ascent loop, and both rounding passes live in
+    VMEM for the whole solve (``solve_dual_decomposition`` is pure
+    ``lax``/``jnp`` and runs verbatim in the kernel body).  Padded
+    buffer rows carry ``valid=False`` and are inert (sentinel-slot
+    scatter), so solving the padded width is bitwise-identical to
+    solving the exact width."""
+    from repic_tpu.solver.dual import solve_dual_decomposition
+
+    mv = jnp.transpose(vid_ref[:][:k, :]).astype(jnp.int32)
+    wv = w_ref[0, :]
+    val = v_ref[0, :] > 0.0
+    stats = solve_dual_decomposition(
+        mv, wv, val, num_vertices, num_iters=num_iters, tol=tol,
+    )
+    p_ref[:] = stats.picked.astype(jnp.int32)[None, :]
+
+
+_SOLVE_PROBE_V = 64
+
+
+def _solve_plan(dims: dict) -> KernelPlan:
+    c = dims["C"]
+    cp = c + (-c % LANE)
+    full = lambda: (0, 0)  # noqa: E731 — grid (1,) takes no index
+    return KernelPlan(
+        grid=(1,),
+        in_blocks=(
+            BlockPlan("vid", (KP, cp), lambda i: (0, 0), (KP, cp),
+                      dtype="int32"),
+            BlockPlan("w", (1, cp), lambda i: (0, 0), (1, cp)),
+            BlockPlan("valid", (1, cp), lambda i: (0, 0), (1, cp)),
+        ),
+        out_blocks=(
+            BlockPlan("picked", (1, cp), lambda i: (0, 0), (1, cp),
+                      dtype="int32"),
+        ),
+    )
+
+
+def _solve_probe_inputs(dims: dict):
+    import numpy as np
+
+    c, k = dims["C"], dims["K"]
+    rng = np.random.default_rng(7 * c + k)
+    mv = jnp.asarray(
+        rng.integers(0, _SOLVE_PROBE_V, (c, k)), jnp.int32
+    )
+    w = jnp.asarray(rng.uniform(0.1, 1.0, (c,)), jnp.float32)
+    valid = jnp.asarray(rng.uniform(size=c) > 0.2)
+    return (mv, w, valid), {}
+
+
+def _solve_reference(member_vertex, w, valid):
+    from repic_tpu.solver.dual import solve_lp_device
+
+    return solve_lp_device(member_vertex, w, valid, _SOLVE_PROBE_V)
+
+
+def _solve_compare(got, want, tol):
+    import numpy as np
+
+    g, r = np.asarray(got), np.asarray(want)
+    if g.shape != r.shape or g.dtype != r.dtype:
+        return [f"picked: kernel ({g.shape}, {g.dtype}) vs "
+                f"reference ({r.shape}, {r.dtype})"]
+    if not np.array_equal(g, r):
+        return [
+            f"picked mask differs on {int(np.sum(g != r))} clique(s)"
+        ]
+    return []
+
+
+@checked(Contract(
+    args={
+        "member_vertex": spec("C K", "int32"),
+        "w": spec("C"),
+        "valid": spec("C", "bool"),
+    },
+    returns=spec("C", "bool"),
+    dims={"C": 16, "K": 3},
+    static={"num_vertices": _SOLVE_PROBE_V, "interpret": True},
+    kernel=KernelContract(
+        plan=_solve_plan,
+        ladder=(
+            {"C": 16, "K": 3},
+            {"C": 100, "K": 4},
+            {"C": 128, "K": 2},
+        ),
+        make_inputs=_solve_probe_inputs,
+        reference=_solve_reference,
+        compare=_solve_compare,
+        tol=0.0,
+    ),
+))
+@functools.partial(
+    jax.jit, static_argnames=("num_vertices", "interpret")
+)
+def fused_dual_solve(
+    member_vertex: jax.Array,
+    w: jax.Array,
+    valid: jax.Array,
+    num_vertices: int,
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """``solve_lp_device`` as one Pallas program (prices in VMEM).
+
+    Signature-compatible with the other solver rungs; bitwise-equal
+    picks (tests/test_megakernel.py).  K <= 6 by the same envelope as
+    the candidate kernel (member rows ride one sublane tile)."""
+    c, k = member_vertex.shape
+    if k > _FUSED_MAX_K:
+        raise ValueError(
+            f"fused solve supports K <= {_FUSED_MAX_K}, got K={k}"
+        )
+    cp = c + (-c % LANE)
+    vid = jnp.pad(
+        jnp.transpose(member_vertex), ((0, KP - k), (0, cp - c))
+    )
+    wrow = jnp.pad(w, (0, cp - c)).reshape(1, cp)
+    vrow = jnp.pad(
+        valid.astype(w.dtype), (0, cp - c)
+    ).reshape(1, cp)
+    from repic_tpu.solver import dual as _dual
+
+    kernel = functools.partial(
+        _solve_kernel,
+        k=k, num_vertices=num_vertices,
+        num_iters=_dual.DEFAULT_NUM_ITERS, tol=_dual.DEFAULT_TOL,
+    )
+    full = lambda i: (0, 0)  # noqa: E731
+    picked = pl.pallas_call(
+        kernel,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec((KP, cp), full),
+            pl.BlockSpec((1, cp), full),
+            pl.BlockSpec((1, cp), full),
+        ],
+        out_specs=pl.BlockSpec((1, cp), full),
+        out_shape=jax.ShapeDtypeStruct((1, cp), jnp.int32),
+        interpret=interpret,
+    )(vid, wrow, vrow)
+    return picked[0, :c] > 0
+
+
+# -- consensus integration --------------------------------------------
+
+
+def fused_cliqueset(
+    xy: jax.Array,
+    conf: jax.Array,
+    mask: jax.Array,
+    box_size,
+    *,
+    threshold: float = 0.3,
+    max_neighbors: int = 16,
+    clique_capacity: int = 4096,
+    interpret: bool | None = None,
+) -> CliqueSet:
+    """The fused kernel's output as a :class:`CliqueSet` — the same
+    valid-row order contract ``enumerate_cliques`` hands
+    ``consensus_one`` on the staged dense path
+    (``max_cell_count``/``max_partial`` are 0: the fused program
+    covers the dense product regime only)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    (member_idx, valid, w, confidence, rep_slot, rep_xy, _pid,
+     num_valid, max_adjacency) = fused_clique_candidates(
+        xy, conf, mask, box_size,
+        threshold=threshold,
+        max_neighbors=max_neighbors,
+        clique_capacity=clique_capacity,
+        interpret=interpret,
+    )
+    return CliqueSet(
+        member_idx=member_idx,
+        valid=valid,
+        w=w,
+        confidence=confidence,
+        rep_slot=rep_slot,
+        rep_xy=rep_xy,
+        max_adjacency=max_adjacency,
+        max_cell_count=jnp.zeros((), jnp.int32),
+        num_valid=num_valid,
+        max_partial=jnp.zeros((), jnp.int32),
+    )

@@ -13,6 +13,8 @@ available in :mod:`repic_tpu.commands` for drop-in parity.
 """
 
 import os
+import queue
+import threading
 import time
 from functools import lru_cache, partial
 from typing import NamedTuple
@@ -81,6 +83,12 @@ _CHUNK_HALVINGS = telemetry.counter(
 _CHUNKS = telemetry.counter(
     "repic_consensus_chunks_total",
     "consensus chunk executions",
+)
+_PREFETCHED_CHUNKS = telemetry.counter(
+    "repic_consensus_prefetched_chunks_total",
+    "chunks produced by the one-deep prefetch worker while the "
+    "consumer was still emitting the previous chunk (device compute "
+    "overlapped with host BOX emission)",
 )
 _MICROGRAPHS = telemetry.counter(
     "repic_consensus_micrographs_total",
@@ -240,9 +248,23 @@ def consensus_one(
     backend: ``"lp_device"`` (the default — batched dual-decomposition
     LP, :mod:`repic_tpu.solver.dual`), ``"lp"`` (LP relaxation +
     rounding) or ``"greedy"`` (parallel greedy dominance); both LP
-    rungs are never worse than greedy.
+    rungs are never worse than greedy.  ``"lp_device_fused"`` runs
+    the megakernel chunk program (:mod:`repic_tpu.ops.megakernel`:
+    IoU -> clique join -> stats -> compaction -> LP solve as two
+    Pallas programs in one dispatch) when the config is inside the
+    fused envelope and the backend requests the kernel path;
+    otherwise it demotes statically to the identical-semantics
+    staged ``lp_device`` program — the fallback rung.
     """
     n = xy.shape[1]
+    k = xy.shape[0]
+    use_megakernel = False
+    if solver == "lp_device_fused":
+        from repic_tpu.ops import megakernel
+
+        use_megakernel = megakernel.use_fused_kernel(
+            k, n, max_neighbors, spatial_grid=spatial_grid
+        )
     # Bound the per-chunk candidate transient (anchors x D^(K-1)) to
     # ~2M tuples regardless of K and D — the K=4 stress config at
     # D=16 would otherwise produce 16.7M-tuple blocks whose edge
@@ -255,7 +277,22 @@ def consensus_one(
     anchor_chunk = int(
         min(4096, max(8, (1 << 21) // max(dprod, 1)))
     )
-    if spatial_grid is not None:
+    if use_megakernel:
+        # Fused chunk program: candidates come out of ONE Pallas
+        # program with valid rows in product order — the same
+        # valid-row relative order as the staged buffers — so the
+        # shared compact_cliques below yields a bitwise-identical
+        # compacted buffer (weight desc, ties by product position).
+        cs = megakernel.fused_cliqueset(
+            xy,
+            conf,
+            mask,
+            box_size,
+            threshold=threshold,
+            max_neighbors=max_neighbors,
+            clique_capacity=clique_capacity,
+        )
+    elif spatial_grid is not None:
         cs = enumerate_cliques_bucketed(
             xy,
             conf,
@@ -285,7 +322,12 @@ def consensus_one(
     num_cliques = cs.num_valid
     cs = compact_cliques(cs, clique_capacity)
     vid, num_vertices = pack_cliques_for_solver(cs.member_idx, cs.valid, n)
-    if solver == "lp_device":
+    if use_megakernel:
+        picked = megakernel.fused_dual_solve(
+            vid, cs.w, cs.valid, num_vertices,
+            interpret=jax.default_backend() != "tpu",
+        )
+    elif solver in ("lp_device", "lp_device_fused"):
         picked = solve_lp_device(vid, cs.w, cs.valid, num_vertices)
     elif solver == "lp":
         picked = solve_lp_rounding(vid, cs.w, cs.valid, num_vertices)
@@ -947,13 +989,32 @@ def run_consensus_batch(
                 cell_capacity=cell_cap, partial_capacity=pcap,
             )
             continue
-        if solver == "lp_device":
+        if solver in ("lp_device", "lp_device_fused"):
             # count the in-program device solves once the capacities
             # are final (escalation retries re-solve the same
             # micrographs); padding rows are not solves
             note_program_solves(
                 sum(1 for n in batch.names if n)
             )
+        if solver == "lp_device_fused":
+            # megakernel chunk accounting mirrors the trace-time
+            # dispatch decision: the same (K, N, D, grid) envelope
+            # check consensus_one used, evaluated at the FINAL
+            # accepted capacities
+            from repic_tpu.ops import megakernel
+
+            k_pickers = int(np.shape(batch.xy)[1])
+            n_padded = int(np.shape(batch.xy)[2])
+            if not megakernel.fused_eligible(
+                k_pickers, n_padded, d, spatial_grid=grid
+            ):
+                megakernel.note_fallback("envelope")
+            elif not megakernel.kernel_requested():
+                megakernel.note_fallback("backend")
+            else:
+                megakernel.note_fused_chunk(
+                    sum(1 for n in batch.names if n)
+                )
         # This batch's exact requirement (the probes are true counts
         # once nothing overflows).  Components whose probe is
         # meaningless on this path (cell count off-grid, partials on
@@ -1446,7 +1507,8 @@ def _maybe_diverge_fallback(
     instead of the stale packed transfer.  Zero cost when no plan is
     active (one attribute read).
     """
-    if solver != "lp_device" or not faults.active():
+    if solver not in ("lp_device", "lp_device_fused") \
+            or not faults.active():
         return res, False
     hit = [
         (i, name)
@@ -1475,9 +1537,66 @@ def _maybe_diverge_fallback(
             journal.record_event(
                 "solver_degraded",
                 micrograph=name,
-                rung="lp_device",
+                rung=solver,
                 fallback=used,
                 reason="diverged",
+            )
+    return res._replace(picked=picked_all), True
+
+
+def _maybe_megakernel_fallback(
+    part, res, capacity, *, solver, outcomes, journal=None
+):
+    """Chaos hook for the fused megakernel rung
+    (``megakernel_fallback`` fault site, docs/robustness.md).
+
+    A real megakernel failure (Mosaic lowering regression, VMEM
+    overflow on an unprobed shape) surfaces at compile/dispatch time
+    and demotes the whole chunk to the staged program via the
+    ladder's OOM/retry policy.  This hook is the deterministic
+    per-micrograph stand-in the faults suite can plant: each
+    micrograph whose name matches a ``megakernel_fallback`` firing
+    has its fused-program packing re-solved on the host ladder
+    starting from the staged ``lp_device`` rung — proving the
+    demotion path end to end with the rung recorded in
+    ``outcomes.solver`` and journaled (``rung="lp_device_fused"``,
+    ``reason="megakernel_fallback"``).  Zero cost without a plan.
+    """
+    if solver != "lp_device_fused" or not faults.active():
+        return res, False
+    hit = [
+        (i, name)
+        for i, (name, _sets) in enumerate(part)
+        if faults.check("megakernel_fallback", name)
+    ]
+    if not hit:
+        return res, False
+    from repic_tpu.ops import megakernel
+
+    picked_all = np.array(np.asarray(res.picked), dtype=bool)
+    K = res.member_idx.shape[-1]
+    offsets = np.arange(K, dtype=np.int64) * int(capacity)
+    for i, name in hit:
+        valid = np.asarray(res.valid[i]).astype(bool)
+        member = np.asarray(res.member_idx[i])[valid].astype(np.int64)
+        wv = np.asarray(res.w[i])[valid]
+        vid = member + offsets[None, :] if member.size else member
+        picked_v, used = solve_host_ladder(
+            vid, wv, K * int(capacity), solver="lp_device"
+        )
+        row = np.zeros(picked_all.shape[1], bool)
+        row[np.where(valid)[0]] = picked_v
+        picked_all[i] = row
+        outcomes.solver[name] = used
+        outcomes.mark([name], "degraded")
+        megakernel.note_fallback("fault")
+        if journal is not None:
+            journal.record_event(
+                "solver_degraded",
+                micrograph=name,
+                rung="lp_device_fused",
+                fallback=used,
+                reason="megakernel_fallback",
             )
     return res._replace(picked=picked_all), True
 
@@ -2148,6 +2267,12 @@ def run_consensus_dir(
                     solver=device_solver, outcomes=outcomes,
                     journal=journal,
                 )
+                res, demoted = _maybe_megakernel_fallback(
+                    part, res, cbatch.capacity,
+                    solver=device_solver, outcomes=outcomes,
+                    journal=journal,
+                )
+                diverged = diverged or demoted
                 if diverged and not want_fetch:
                     # the packed transfer predates the host re-solve:
                     # re-render this chunk from the patched result
@@ -2760,7 +2885,7 @@ def run_consensus_dir(
         tlm_server.set_status(phase="finished")
 
 
-def iter_consensus_chunks(
+def _iter_chunks_serial(
     loaded,
     box_size,
     *,
@@ -2780,10 +2905,11 @@ def iter_consensus_chunks(
     journal: "RunJournal | None" = None,
     cancel=None,
 ):
-    """Run consensus over memory-bounded micrograph chunks.
+    """Run consensus over memory-bounded micrograph chunks, serially.
 
-    The shared chunking engine behind :func:`run_consensus_dir` and
-    the two-phase ``get_cliques`` CLI.  When one chunk covers the
+    The shared chunking engine behind :func:`iter_consensus_chunks`
+    (which adds the one-deep prefetch overlap), and through it
+    :func:`run_consensus_dir` and the two-phase ``get_cliques`` CLI.  When one chunk covers the
     whole workload, padding sticks to the mesh axis (the historical
     single-batch shapes, so recorded capacity configs and compiled
     programs stay valid); otherwise every chunk pads to the same
@@ -3013,3 +3139,140 @@ def iter_consensus_chunks(
         attempts = 0
         yield part, cbatch, res, extras, time.time() - t1
         i += len(part)
+
+
+#: escape hatch: set to 1/true/yes to force the serial chunk loop
+#: (no prefetch worker thread) — for debugging or single-threaded
+#: embedding contexts
+NO_PREFETCH_ENV = "REPIC_TPU_NO_PREFETCH"
+
+
+def _prefetch_disabled() -> bool:
+    val = os.environ.get(NO_PREFETCH_ENV, "").strip().lower()
+    return val in ("1", "true", "yes")
+
+
+def _prefetch_chunks(gen):
+    """Run ``gen`` one item ahead in a worker thread.
+
+    The double-buffer behind :func:`iter_consensus_chunks`: while the
+    consumer emits/journals chunk *i*, the worker is already inside
+    ``run_consensus_batch`` (device compute + the packed fetch) for
+    chunk *i+1*.  A ``Queue(maxsize=1)`` bounds the lookahead to one
+    chunk, so host memory holds at most two fetched chunk results and
+    cancellation/deadline checks lag by at most one chunk.
+
+    Ordering contract: the queue is FIFO and the worker is the ONLY
+    thread advancing ``gen``, so the consumer observes exactly the
+    serial sequence — emit, journal, and trace order are unchanged.
+    Journal writes are line-atomic (``RunJournal._append`` locks) and
+    the worker is bound to the caller's trace context via
+    :func:`repic_tpu.telemetry.trace.thread_target`, so ladder events
+    recorded by the worker keep their request trace id.
+
+    Exceptions (including :class:`ConsensusCancelled`) re-raise in
+    the consumer at the point the failed chunk would have been
+    yielded.  An early ``close()`` of the consumer sets the stop
+    event and joins the worker, which closes ``gen`` in-thread so its
+    ``finally`` blocks run exactly as in the serial path.
+    """
+    q = queue.Queue(maxsize=1)
+    stop = threading.Event()
+    _DONE = object()
+
+    def _pump():
+        try:
+            while not stop.is_set():
+                try:
+                    item = next(gen)
+                except StopIteration:
+                    item, err = _DONE, None
+                except BaseException as e:  # noqa: BLE001 — re-raised
+                    item, err = _DONE, e
+                else:
+                    err = None
+                # bounded put that still observes a consumer stop
+                while not stop.is_set():
+                    try:
+                        q.put((item, err), timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if item is _DONE:
+                    return
+        finally:
+            # run the serial generator's finally blocks in the SAME
+            # thread that iterated it (required by the generator
+            # protocol when the consumer abandons us early)
+            gen.close()
+
+    worker = threading.Thread(
+        target=tlm_trace.thread_target(_pump),
+        name="repic-chunk-prefetch",
+        daemon=True,
+    )
+    worker.start()
+    try:
+        first = True
+        while True:
+            # overlap must be judged BEFORE the get: a chunk already
+            # waiting in the queue when the consumer returns from
+            # emitting the previous one is genuine compute/emit
+            # overlap.  (Checking after the get races the producer's
+            # wake-up from its blocked put — the queue reads empty
+            # for the microseconds it takes the worker to re-insert,
+            # so overlap would almost never register.)
+            ready = not first and not q.empty()
+            item, err = q.get()
+            if err is not None:
+                raise err
+            if item is _DONE:
+                return
+            if ready:
+                _PREFETCHED_CHUNKS.inc()
+            first = False
+            yield item
+    finally:
+        stop.set()
+        # unblock a worker parked in q.put by draining
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        worker.join(timeout=30.0)
+
+
+def iter_consensus_chunks(
+    loaded,
+    box_size,
+    *,
+    prefetch: bool | None = None,
+    **kwargs,
+):
+    """Run consensus over memory-bounded micrograph chunks.
+
+    Identical signature and yield contract to
+    :func:`_iter_chunks_serial` (see its docstring for the chunk
+    ladder and every keyword), plus:
+
+    Args:
+        prefetch: overlap chunk *i+1*'s device compute + fetch with
+            the consumer's emission of chunk *i* by running the chunk
+            loop one step ahead in a worker thread.  ``None`` (the
+            default) enables it unless ``REPIC_TPU_NO_PREFETCH`` is
+            set.  The yielded sequence, journal records, and trace
+            attribution are identical either way — prefetch only
+            moves WHEN the next chunk's work starts.
+
+    Yields:
+        ``(part, batch, result, extras, seconds)`` per chunk, exactly
+        as the serial engine.
+    """
+    gen = _iter_chunks_serial(loaded, box_size, **kwargs)
+    if prefetch is None:
+        prefetch = not _prefetch_disabled()
+    if not prefetch:
+        yield from gen
+        return
+    yield from _prefetch_chunks(gen)

@@ -80,11 +80,14 @@ class ConsensusOptions:
     max_retries: int | None = None
 
     def __post_init__(self):
-        if self.solver not in ("greedy", "lp", "lp_device"):
+        if self.solver not in (
+            "greedy", "lp", "lp_device", "lp_device_fused"
+        ):
             raise ValueError(
-                f"engine solver must be 'greedy', 'lp' or 'lp_device',"
-                f" got {self.solver!r} (the host-side 'exact' ladder "
-                "is a run_consensus_dir mode, not a serve mode)"
+                f"engine solver must be 'greedy', 'lp', 'lp_device' "
+                f"or 'lp_device_fused', got {self.solver!r} (the "
+                "host-side 'exact' ladder is a run_consensus_dir "
+                "mode, not a serve mode)"
             )
 
     @classmethod

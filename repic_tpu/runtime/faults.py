@@ -25,6 +25,17 @@ site              raised at the matching call site
                   degrading to the host ladder (``lp`` -> ``greedy``)
                   with the rung journaled — the deterministic
                   stand-in for dual-ascent divergence
+``megakernel_fallback`` no exception — polled by the directory
+                  pipeline per micrograph (key: the micrograph
+                  name) when the fused megakernel rung
+                  (``solver="lp_device_fused"``) executed the
+                  chunk; a firing demotes that micrograph's
+                  packing to the host ladder starting from the
+                  staged ``lp_device`` rung, journaled as
+                  ``rung="lp_device_fused"`` /
+                  ``reason="megakernel_fallback"`` — the
+                  deterministic stand-in for a Mosaic lowering or
+                  VMEM-overflow failure of the fused program
 ``host_crash``    no exception — polled by
                   ``runtime.cluster.ClusterContext.crash_point``,
                   which terminates the process with
@@ -159,6 +170,7 @@ KNOWN_SITES = (
     "corrupt_box",
     "solver_budget",
     "solver_diverge",
+    "megakernel_fallback",
     "host_crash",
     "heartbeat_stall",
     "lease_race",

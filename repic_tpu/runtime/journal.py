@@ -44,6 +44,7 @@ import glob
 import json
 import os
 import re
+import threading
 import time
 
 from repic_tpu.runtime.atomic import atomic_write, file_lock
@@ -137,6 +138,11 @@ class RunJournal:
         self._latest: dict[str, dict] = {}
         self._events: list[dict] = []
         self._fh = None
+        # One journal is written from more than one thread: the chunk
+        # prefetch worker (iter_consensus_chunks) records ladder
+        # events while the consumer thread records per-micrograph
+        # outcomes.  Writes are line-atomic under this lock.
+        self._wlock = threading.Lock()
 
     # -- lifecycle ----------------------------------------------------
 
@@ -199,9 +205,10 @@ class RunJournal:
         return j
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._wlock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     def __enter__(self):
         return self
@@ -242,10 +249,16 @@ class RunJournal:
         tid = current_trace_id()
         if tid is not None and "trace" not in entry:
             entry["trace"] = tid
-        if self._fh is None:
-            self._fh = open(self.path, "at")
-        self._fh.write(json.dumps(entry) + "\n")
-        self._fh.flush()
+        line = json.dumps(entry) + "\n"
+        # serializing the write+flush IS this lock's purpose: the
+        # prefetch worker and the emitting consumer share one append
+        # handle, and a flush outside the lock could interleave two
+        # half-written lines in the durability contract's file
+        with self._wlock:  # repic: noqa[RT303]
+            if self._fh is None:
+                self._fh = open(self.path, "at")
+            self._fh.write(line)
+            self._fh.flush()
 
     # -- reads --------------------------------------------------------
 

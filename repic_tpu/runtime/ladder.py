@@ -160,6 +160,10 @@ class ChunkOutcomes:
 # ladder stays reachable exactly as before.
 SOLVER_LADDER = {
     "exact": ("exact", "lp", "greedy"),
+    # the fused megakernel rung demotes FIRST to the staged program
+    # with the identical lp_device solve (same math, separate
+    # dispatches), then through the host rungs like lp_device
+    "lp_device_fused": ("lp_device", "lp", "greedy"),
     "lp_device": ("lp_device", "lp", "greedy"),
     "lp": ("lp", "greedy"),
     "greedy": ("greedy",),

@@ -36,6 +36,7 @@ import itertools
 import json
 import os
 import sys
+import threading
 import time
 import uuid
 
@@ -90,18 +91,29 @@ class EventLog:
         self.run_id = run_id or new_run_id()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._fh = open(path, "at")
+        # spans close from both the chunk-prefetch worker and the
+        # emitting consumer thread (iter_consensus_chunks): writes
+        # must be line-atomic on the shared handle
+        self._wlock = threading.Lock()
 
     def write(self, record: dict) -> None:
-        if self._fh is None:
-            return
         record.setdefault("run", self.run_id)
-        self._fh.write(json.dumps(record, default=str) + "\n")
-        self._fh.flush()
+        line = json.dumps(record, default=str) + "\n"
+        # serializing the write+flush IS this lock's purpose: span
+        # records arrive from the prefetch worker and the consumer on
+        # one shared handle, and flushing outside the lock could
+        # interleave two half-written lines
+        with self._wlock:  # repic: noqa[RT303]
+            if self._fh is None:
+                return
+            self._fh.write(line)
+            self._fh.flush()
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._wlock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
     def __enter__(self):
         return self

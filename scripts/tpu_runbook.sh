@@ -45,6 +45,7 @@ PALLAS_OUT="PALLAS_TPU_${TAG}.jsonl"
 # stress row still banks headline+stress instead of discarding all
 # three (the whole point of a catcher for minutes-long windows).
 BD_HEADLINE_OUT="BREAKDOWN_TPU_${TAG}_headline.jsonl"
+BD_PROBECHECK_OUT="BREAKDOWN_TPU_${TAG}_probecheck.jsonl"
 BD_STRESS_OUT="BREAKDOWN_TPU_${TAG}_stress.jsonl"
 BD_1024_OUT="BREAKDOWN_TPU_${TAG}_batch1024.jsonl"
 TRAIN_OUT="TRAIN_TPU_${TAG}.jsonl"
@@ -142,6 +143,15 @@ runbook() {
     [ "$rc" -eq 1 ] && return 1; [ "$rc" -ne 0 ] && incomplete=1
     step bd_headline 900 "$BD_HEADLINE_OUT" "$PY" bench_breakdown.py \
         --workloads headline; rc=$?
+    [ "$rc" -eq 1 ] && return 1; [ "$rc" -ne 0 ] && incomplete=1
+    # Packed-vs-separate transfer cross-check (ROADMAP carry-over):
+    # the single-transfer output fusion landed between windows and the
+    # chip has never confirmed it.  Also the roofline re-measure
+    # evidence: bd_headline's device_exec_s is the chain-amortized
+    # denominator that replaces the RTT-charged 703.5 GB/s lower
+    # bound with a real achieved-bandwidth figure.
+    step bd_probecheck 900 "$BD_PROBECHECK_OUT" "$PY" bench_breakdown.py \
+        --workloads probecheck; rc=$?
     [ "$rc" -eq 1 ] && return 1; [ "$rc" -ne 0 ] && incomplete=1
     # The MXU workload: small compile, dramatic TPU-vs-CPU ratio —
     # bank it early in the window.

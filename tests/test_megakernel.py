@@ -1,0 +1,410 @@
+"""Fused megakernel chunk program (``solver="lp_device_fused"``).
+
+The ISSUE 19 acceptance surface: golden fused-vs-staged equality
+across the ragged shape ladder (including an all-masked micrograph
+and a zero-clique field), dir-level BOX byte-identity on the
+examples/10017 reference set, the ``megakernel_fallback`` fault
+site's journaled ladder demotion, KERNELCHECK differential probes of
+both fused contracts, and the one-deep chunk prefetch that overlaps
+BOX emission with device compute.
+
+The equality contract everywhere below: fused and staged programs
+agree on the valid mask, on ``picked`` over the FULL buffer, and on
+every field restricted to valid rows.  Rows past the compaction
+frontier carry whatever each program's scatter left there —
+different garbage, read by nothing — so full-buffer equality of
+``member_idx``/``rep_slot``/``rep_xy`` is NOT part of the contract
+and legitimately fails.
+"""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from repic_tpu.parallel.batching import pad_batch
+from repic_tpu.pipeline import consensus as C
+from repic_tpu.pipeline.consensus import run_consensus_batch, run_consensus_dir
+from repic_tpu.runtime import faults
+from repic_tpu.runtime.journal import read_journal
+from repic_tpu.utils.box_io import BoxSet
+from tests.conftest import REFERENCE_EXAMPLES, needs_reference
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from bench_stress import synthesize  # noqa: E402
+
+FORCE_ENV = "REPIC_TPU_MEGAKERNEL_FORCE"
+_VALID_ROW_FIELDS = ("member_idx", "rep_slot", "w", "confidence", "rep_xy")
+
+
+def _assert_fused_matches_staged(res_staged, res_fused):
+    valid = np.asarray(res_staged.valid)
+    np.testing.assert_array_equal(
+        valid, np.asarray(res_fused.valid), err_msg="valid"
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res_staged.picked),
+        np.asarray(res_fused.picked),
+        err_msg="picked",
+    )
+    for f in _VALID_ROW_FIELDS:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(res_staged, f))[valid],
+            np.asarray(getattr(res_fused, f))[valid],
+            err_msg=f,
+        )
+
+
+def _run_both(batch, box_size, monkeypatch, **kw):
+    """Staged then fused (kernel forced into interpret mode) on the
+    same batch; capacity escalation from the first run is reused by
+    the second, so both solve at identical static shapes."""
+    monkeypatch.delenv(FORCE_ENV, raising=False)
+    res_staged = run_consensus_batch(
+        batch, box_size, use_mesh=False, solver="lp_device", **kw
+    )
+    monkeypatch.setenv(FORCE_ENV, "1")
+    res_fused = run_consensus_batch(
+        batch, box_size, use_mesh=False, solver="lp_device_fused", **kw
+    )
+    monkeypatch.delenv(FORCE_ENV, raising=False)
+    return res_staged, res_fused
+
+
+def _batch(m=2, k=3, n=64, seed=0):
+    from repic_tpu.parallel.batching import PaddedBatch
+
+    xy, conf, mask = synthesize(m, k, n, seed=seed)
+    return PaddedBatch(
+        xy=xy, conf=conf, mask=mask,
+        names=tuple(f"m{i}" for i in range(m)),
+        counts=np.full((m, k), n, np.int32),
+    )
+
+
+# -- golden fused-vs-staged over the shape ladder ---------------------
+
+
+# k=3 is exercised by the ragged-counts test below and the 10017
+# byte-identity run; parametrizing it here too would only add two
+# more XLA compiles to the tier-1 wall clock
+@pytest.mark.parametrize("k", [2, 4])
+def test_fused_matches_staged(monkeypatch, k):
+    batch = _batch(m=2, k=k, n=48, seed=k)
+    res_s, res_f = _run_both(batch, 180.0, monkeypatch)
+    assert int(np.sum(np.asarray(res_s.num_cliques))) > 0
+    _assert_fused_matches_staged(res_s, res_f)
+
+
+def test_fused_matches_staged_ragged_counts(monkeypatch):
+    """Per-picker ragged particle counts (the pad_batch path)."""
+    rng = np.random.default_rng(7)
+    base = rng.uniform(100, 900, size=(40, 2)).astype(np.float32)
+
+    def _set(n):
+        xy = base[:n] + rng.normal(0, 8, size=(n, 2)).astype(np.float32)
+        return BoxSet(
+            xy=xy,
+            conf=rng.uniform(0.1, 1.0, n).astype(np.float32),
+            wh=np.full((n, 2), 64.0, np.float32),
+        )
+
+    loaded = [
+        ("ragged0", [_set(40), _set(25), _set(33)]),
+        ("ragged1", [_set(12), _set(40), _set(7)]),
+    ]
+    batch = pad_batch(loaded)
+    res_s, res_f = _run_both(batch, 64.0, monkeypatch)
+    assert int(np.sum(np.asarray(res_s.num_cliques))) > 0
+    _assert_fused_matches_staged(res_s, res_f)
+
+
+def test_fused_matches_staged_empty_and_zero_clique(monkeypatch):
+    """One batch carrying the two degenerate shards — an all-masked
+    micrograph (empty shard) and one whose pickers never overlap
+    cross-picker (zero cliques) — next to a dense sibling: both
+    programs return all-invalid buffers for the degenerate rows
+    without perturbing the dense one.  (One batch = one compile pair
+    for all three cases; the properties are per-micrograph.)"""
+    batch = _batch(m=3, k=3, n=32, seed=1)
+    mask = batch.mask.copy()
+    mask[1] = False
+    counts = batch.counts.copy()
+    counts[1] = 0
+    # shove micrograph 2's pickers to mutually far-apart regions
+    xy = batch.xy.copy()
+    xy[2] += np.arange(3, dtype=np.float32).reshape(3, 1, 1) * 50_000.0
+    batch = batch._replace(mask=mask, counts=counts, xy=xy)
+    res_s, res_f = _run_both(batch, 180.0, monkeypatch)
+    assert int(np.asarray(res_s.num_cliques)[0]) > 0   # dense sibling
+    assert int(np.asarray(res_s.num_cliques)[1]) == 0  # empty shard
+    assert int(np.asarray(res_s.num_cliques)[2]) == 0  # zero-clique
+    assert not np.asarray(res_f.valid[1]).any()
+    assert not np.asarray(res_f.valid[2]).any()
+    _assert_fused_matches_staged(res_s, res_f)
+
+
+# -- envelope + dispatch gating ---------------------------------------
+
+
+def test_fused_envelope(monkeypatch):
+    from repic_tpu.ops import megakernel as mk
+
+    assert mk.fused_eligible(3, 1024, 16)
+    assert mk.fused_eligible(2, 8192, 64)
+    assert not mk.fused_eligible(1, 1024, 16)      # no join to fuse
+    assert not mk.fused_eligible(7, 1024, 4)       # K past the envelope
+    assert not mk.fused_eligible(3, 8193, 16)      # N past the envelope
+    assert not mk.fused_eligible(4, 1024, 64)      # d^(k-1) product blowup
+    assert not mk.fused_eligible(
+        3, 1024, 16, spatial_grid=(8, 8)
+    )                                              # bucketed path owns grids
+
+    monkeypatch.delenv(FORCE_ENV, raising=False)
+    import jax
+
+    assert mk.kernel_requested() == (jax.default_backend() == "tpu")
+    for val in ("1", "true", "yes"):
+        monkeypatch.setenv(FORCE_ENV, val)
+        assert mk.kernel_requested()
+    monkeypatch.setenv(FORCE_ENV, "0")
+    assert mk.kernel_requested() == (jax.default_backend() == "tpu")
+
+
+# -- KERNELCHECK: differential probes of the fused contracts ----------
+
+
+@pytest.mark.slow
+def test_kernelcheck_fused_contracts_zero_violations():
+    """Both fused entries carry a KernelContract whose full shape
+    ladder probes clean (interpret kernel vs pure-jnp reference).
+
+    Marked slow (~15s of probe ladders): tier-1 already exercises the
+    same contracts through ``repic-tpu check`` in CI's kernelcheck
+    job, which runs this file without the marker filter."""
+    import repic_tpu.ops.megakernel  # noqa: F401 — registers contracts
+    from repic_tpu.analysis import contracts
+    from repic_tpu.analysis.kernels import differential_probe
+
+    entries = {
+        name: e
+        for name, e in contracts.registry().items()
+        if "megakernel" in name
+    }
+    assert len(entries) >= 2, sorted(entries)
+    for name, entry in sorted(entries.items()):
+        kc = entry.contract.kernel
+        assert kc is not None, name
+        for dims in kc.ladder:
+            msgs = differential_probe(entry, kc, dims=dims)
+            assert not msgs, (name, dims, msgs)
+
+
+# -- dir-level BOX byte-identity on the reference set -----------------
+
+
+@needs_reference
+def test_mini10017_fused_box_byte_identity(tmp_path, monkeypatch):
+    """The fused rung writes byte-identical BOX files to the staged
+    rung over the real examples/10017 picker set."""
+    monkeypatch.delenv(FORCE_ENV, raising=False)
+    out_s = str(tmp_path / "staged")
+    run_consensus_dir(
+        REFERENCE_EXAMPLES, out_s, 180, use_mesh=False,
+        solver="lp_device",
+    )
+    monkeypatch.setenv(FORCE_ENV, "1")
+    out_f = str(tmp_path / "fused")
+    run_consensus_dir(
+        REFERENCE_EXAMPLES, out_f, 180, use_mesh=False,
+        solver="lp_device_fused",
+    )
+    boxes = sorted(
+        f for f in os.listdir(out_s) if f.endswith(".box")
+    )
+    assert boxes
+    for f in boxes:
+        with open(os.path.join(out_s, f), "rb") as fh:
+            staged = fh.read()
+        with open(os.path.join(out_f, f), "rb") as fh:
+            fused = fh.read()
+        assert staged == fused, f
+
+
+# -- megakernel_fallback: journaled ladder demotion -------------------
+
+
+def _make_dir(tmp_path, m=4, k=3, n=24, seed=0):
+    rng = np.random.default_rng(seed)
+    d = tmp_path / "picks"
+    for p in range(k):
+        (d / f"picker{p}").mkdir(parents=True)
+    for i in range(m):
+        base = rng.uniform(50, 950, size=(n, 2))
+        for p in range(k):
+            jit = rng.normal(0, 10, size=base.shape)
+            conf = rng.uniform(0.1, 1.0, size=n)
+            with open(d / f"picker{p}" / f"mic{i}.box", "wt") as f:
+                for (x, y), c in zip(base + jit, conf):
+                    f.write(f"{x:.2f}\t{y:.2f}\t64\t64\t{c:.4f}\n")
+    return str(d)
+
+
+@pytest.mark.faults
+def test_megakernel_fallback_demotes_and_journals(tmp_path, monkeypatch):
+    """A planted ``megakernel_fallback`` firing re-solves exactly the
+    named micrograph on the host ladder from the staged rung, marks
+    it degraded, journals the demotion with the fused rung named, and
+    leaves every sibling on the fused rung — with outputs written
+    for all."""
+    monkeypatch.setenv(FORCE_ENV, "1")
+    data = _make_dir(tmp_path)
+    out = str(tmp_path / "out")
+    with faults.fault_plan("megakernel_fallback:mic1:1"):
+        stats = run_consensus_dir(
+            data, out, 64, use_mesh=False, solver="lp_device_fused"
+        )
+        assert ("megakernel_fallback", "mic1") in faults.fired_log()
+    assert sorted(stats["particle_counts"]) == [
+        f"mic{i}" for i in range(4)
+    ]
+    for i in range(4):
+        assert os.path.exists(os.path.join(out, f"mic{i}.box"))
+    latest = {e["name"]: e for e in read_journal(out) if "name" in e}
+    assert latest["mic1"]["solver"] in ("lp_device", "lp", "greedy")
+    assert latest["mic1"]["status"] == "degraded"
+    for i in (0, 2, 3):
+        assert latest[f"mic{i}"]["status"] == "ok"
+    events = [
+        e for e in read_journal(out)
+        if e.get("event") == "solver_degraded"
+    ]
+    assert len(events) == 1
+    assert events[0]["micrograph"] == "mic1"
+    assert events[0]["rung"] == "lp_device_fused"
+    assert events[0]["reason"] == "megakernel_fallback"
+
+
+# (the clean-run fused directory surface — every micrograph ok, no
+# demotion — is covered by the 10017 byte-identity run above and the
+# ok-siblings assertions of the fallback test)
+
+
+# -- chunk prefetch: overlap device compute with BOX emission ---------
+
+
+def _prefetch_threads():
+    return [
+        t for t in threading.enumerate()
+        if t.name == "repic-chunk-prefetch" and t.is_alive()
+    ]
+
+
+def test_prefetch_preserves_sequence():
+    def gen():
+        yield from range(100)
+
+    assert list(C._prefetch_chunks(gen())) == list(range(100))
+    assert not _prefetch_threads()
+
+
+def test_prefetch_propagates_generator_error():
+    def gen():
+        yield 0
+        yield 1
+        raise ValueError("boom at item 2")
+
+    it = C._prefetch_chunks(gen())
+    assert next(it) == 0
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="boom at item 2"):
+        next(it)
+    assert not _prefetch_threads()
+
+
+def test_prefetch_early_close_stops_worker():
+    """Closing the consumer mid-stream must join the worker and close
+    the inner generator (no orphan thread keeps pulling chunks)."""
+    closed = threading.Event()
+
+    def gen():
+        try:
+            i = 0
+            while True:
+                yield i
+                i += 1
+        finally:
+            closed.set()
+
+    it = C._prefetch_chunks(gen())
+    assert next(it) == 0
+    it.close()
+    assert closed.wait(timeout=10.0)
+    deadline = time.time() + 10.0
+    while _prefetch_threads() and time.time() < deadline:
+        time.sleep(0.01)
+    assert not _prefetch_threads()
+
+
+def test_prefetch_counts_overlapped_chunks():
+    """A slow consumer behind a fast producer registers overlap on
+    the ``repic_consensus_prefetched_chunks_total`` counter."""
+    before = C._PREFETCHED_CHUNKS.value()
+
+    def gen():
+        yield from range(5)
+
+    for _ in C._prefetch_chunks(gen()):
+        time.sleep(0.02)
+    assert C._PREFETCHED_CHUNKS.value() > before
+
+
+def test_prefetch_env_escape_hatch(monkeypatch):
+    monkeypatch.delenv(C.NO_PREFETCH_ENV, raising=False)
+    assert not C._prefetch_disabled()
+    for val in ("1", "true", "YES"):
+        monkeypatch.setenv(C.NO_PREFETCH_ENV, val)
+        assert C._prefetch_disabled()
+    monkeypatch.setenv(C.NO_PREFETCH_ENV, "0")
+    assert not C._prefetch_disabled()
+
+
+def test_prefetch_dir_run_byte_identity(tmp_path, monkeypatch):
+    """A multi-chunk directory run emits byte-identical BOX files
+    with the prefetch worker on and off (the overlap is pure
+    scheduling, never reordering or dropping chunks)."""
+    data = _make_dir(tmp_path, m=6, seed=5)
+    monkeypatch.setenv("REPIC_CONSENSUS_CHUNK", "2")  # force 3 chunks
+
+    monkeypatch.setenv(C.NO_PREFETCH_ENV, "1")
+    out_serial = str(tmp_path / "serial")
+    run_consensus_dir(data, out_serial, 64, use_mesh=False)
+
+    monkeypatch.delenv(C.NO_PREFETCH_ENV, raising=False)
+    out_prefetch = str(tmp_path / "prefetch")
+    run_consensus_dir(data, out_prefetch, 64, use_mesh=False)
+    assert not _prefetch_threads()
+
+    boxes = sorted(
+        f for f in os.listdir(out_serial) if f.endswith(".box")
+    )
+    assert len(boxes) == 6
+    for f in boxes:
+        with open(os.path.join(out_serial, f), "rb") as fh:
+            serial = fh.read()
+        with open(os.path.join(out_prefetch, f), "rb") as fh:
+            prefetched = fh.read()
+        assert serial == prefetched, f
+    # journal written from both worker and consumer threads stays
+    # one-valid-JSON-object-per-line
+    latest = {
+        e["name"]: e
+        for e in read_journal(out_prefetch)
+        if "name" in e
+    }
+    assert sorted(latest) == [f"mic{i}" for i in range(6)]
