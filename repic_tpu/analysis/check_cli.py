@@ -71,9 +71,28 @@ def main(args: argparse.Namespace) -> None:
         select = {
             s.strip().upper() for s in args.select.split(",") if s.strip()
         }
-        unknown = select - set(SEMANTIC_RULES) - set(KERNEL_RULES)
+        from repic_tpu.analysis.cost import COST_RULES
+
+        unknown = (
+            select
+            - set(SEMANTIC_RULES)
+            - set(KERNEL_RULES)
+            - set(COST_RULES)
+        )
         if unknown:
             sys.exit(f"unknown rule id(s): {', '.join(sorted(unknown))}")
+        cost_only = select & set(COST_RULES)
+        if cost_only:
+            # RT5xx live in the static pass, not the trace-time
+            # checker; a contract-anchored select (e.g. RT511 on a
+            # KernelContract) must not die with "unknown rule" here,
+            # but the findings come from `repic-tpu lint --cost`.
+            print(
+                f"note: {', '.join(sorted(cost_only))} are static "
+                f"device-cost rules; run `repic-tpu lint --cost "
+                f"--select {','.join(sorted(cost_only))}`",
+                file=sys.stderr,
+            )
     report = run_check(
         args.paths, select=select, collect_only=args.list_entries
     )

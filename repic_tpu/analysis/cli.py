@@ -30,10 +30,14 @@ def add_arguments(parser: argparse.ArgumentParser) -> None:
         "with --spmd, additionally runs the whole-program RT401-RT404 "
         "SPMD-uniformity pass (host-divergent branches guarding "
         "collectives, mismatched collective order, host syncs in "
-        "sharded entries, untagged gang journal writes); with --deep, "
-        "runs the trace-time semantic checker (`repic-tpu check`, "
-        "rules RT1xx plus the RT42x Pallas kernel contracts) AND the "
-        "concurrency AND spmd passes over the same paths."
+        "sharded entries, untagged gang journal writes); with --cost, "
+        "additionally runs the whole-program RT501-RT512 device-cost "
+        "pass (dispatch chains, loop fetch feedback, unbucketed "
+        "compile shapes, static VMEM budgets, declared dispatch "
+        "budgets); with --deep, runs the trace-time semantic checker "
+        "(`repic-tpu check`, rules RT1xx plus the RT42x Pallas "
+        "kernel contracts) AND the concurrency AND spmd AND cost "
+        "passes over the same paths."
     )
     parser.add_argument(
         "paths",
@@ -69,6 +73,13 @@ def add_arguments(parser: argparse.ArgumentParser) -> None:
         "names an RT40x rule)",
     )
     parser.add_argument(
+        "--cost",
+        action="store_true",
+        help="also run the whole-program RT5xx device-cost & "
+        "transfer-discipline pass (stdlib-only, like lint itself; "
+        "auto-enabled when --select names an RT5xx rule)",
+    )
+    parser.add_argument(
         "--hints",
         action="store_true",
         help="append each rule's fix-hint to its findings",
@@ -93,6 +104,7 @@ def add_arguments(parser: argparse.ArgumentParser) -> None:
 
 def main(args: argparse.Namespace) -> None:
     from repic_tpu.analysis.concurrency import CONCURRENCY_RULES
+    from repic_tpu.analysis.cost import COST_RULES
     from repic_tpu.analysis.engine import (
         dedupe_findings,
         format_report,
@@ -110,6 +122,8 @@ def main(args: argparse.Namespace) -> None:
             print(f"{rule.rule_id} [{rule.severity}] {rule.title}")
         for rule in SPMD_RULES.values():
             print(f"{rule.rule_id} [{rule.severity}] {rule.title}")
+        for rule in COST_RULES.values():
+            print(f"{rule.rule_id} [{rule.severity}] {rule.title}")
         for rule_id, (severity, title, _hint) in sorted(
             KERNEL_RULES.items()
         ):
@@ -123,6 +137,7 @@ def main(args: argparse.Namespace) -> None:
         known = {r.rule_id for r in ALL_RULES}
         known |= set(CONCURRENCY_RULES)
         known |= set(SPMD_RULES)
+        known |= set(COST_RULES)
         if args.deep:
             from repic_tpu.analysis.kernels import KERNEL_RULES
             from repic_tpu.analysis.semantic import SEMANTIC_RULES
@@ -136,6 +151,8 @@ def main(args: argparse.Namespace) -> None:
             args.concurrency = True
         if select & set(SPMD_RULES):
             args.spmd = True
+        if select & set(COST_RULES):
+            args.cost = True
     findings = run_paths(args.paths, select=select)
     if args.concurrency or args.deep:
         # whole-program RT3xx pass: still pure stdlib ast, but it
@@ -150,6 +167,13 @@ def main(args: argparse.Namespace) -> None:
         from repic_tpu.analysis.spmd import run_spmd
 
         findings.extend(run_spmd(args.paths, select=select))
+    if args.cost or args.deep:
+        # whole-program RT5xx device-cost pass: same Program
+        # machinery, same stdlib-only discipline (the RT511 sandbox
+        # executes only whitelisted arithmetic from the lint targets)
+        from repic_tpu.analysis.cost import run_cost
+
+        findings.extend(run_cost(args.paths, select=select))
     if args.deep:
         # the semantic pass imports JAX + the targets; lint alone
         # must stay import-free, so this lives behind the flag

@@ -108,6 +108,14 @@ class Contract:
             output aliasing) plus the interpret-mode differential
             probe to ``repic-tpu check`` and KERNELCHECK.  Typed
             ``object`` so this module keeps importing no JAX.
+        dispatch_budget: declared maximum device-program launches one
+            invocation of this entry may cost (the RT5xx device-cost
+            pass).  Statically, RT512 counts the jitted programs /
+            bare ``pallas_call`` sites reachable along the entry's
+            call graph against this; dynamically, DISPATCHCHECK
+            (``REPIC_TPU_DISPATCHCHECK=1``) asserts the journaled
+            per-chunk dispatch+fetch count of chunks attributed to
+            this entry stays within it.  ``None`` opts out.
     """
 
     args: dict | None = None
@@ -120,6 +128,7 @@ class Contract:
     donate: tuple = ()
     max_trace_variants: int = 4
     kernel: object = None
+    dispatch_budget: int | None = None
 
 
 @dataclasses.dataclass

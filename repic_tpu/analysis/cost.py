@@ -1,0 +1,1065 @@
+"""Whole-program device-cost & transfer-discipline analysis: RT5xx.
+
+The PR 18/19 device-solver work moved the consensus hot path onto the
+accelerator: a chunk is ONE fused program launch plus ONE packed
+fetch, and the round-5 RTT breakdown showed every extra dispatch or
+host round trip on that path costs more than the compute it moves.
+This pass is the static gate for that discipline — the fifth analysis
+layer next to per-file hygiene (RT0xx), trace-time contracts
+(``repic-tpu check``), project contracts (RT2xx), concurrency
+(RT3xx), and SPMD uniformity (RT40x).
+
+Like RT3xx/RT40x it parses every module under the given paths into
+one :class:`~repic_tpu.analysis.concurrency.Program` (the PR 9
+cross-module import-map machinery) and reasons over resolved call
+edges:
+
+RT501  dispatch chain — consecutive jitted device programs whose
+       output feeds the next's input with no host use in between.
+       One hand-off is the ubiquitous composition idiom; a chain of
+       THREE or more programs re-crosses the launch boundary where a
+       single fused program (see ``lp_device_fused``) would keep the
+       intermediates in VMEM.  A host fetch of the intermediate
+       breaks the chain (the host genuinely needed the value), as
+       does reassignment.  Call sites inside functions that are
+       themselves jitted are exempt: inside a trace, composition is
+       fusion, not dispatch.
+RT502  device->host fetch feeding a device call from inside a loop —
+       ``float()``/``int()``/``bool()`` on a device value,
+       ``.item()``/``.tolist()``, ``np.asarray``/``jax.device_get``
+       inside a ``for``/``while`` whose result feeds back into a call
+       that launches (or transitively reaches) a device program.
+       Each iteration pays a full serialized round trip over a
+       tunneled TPU — the per-item ladder shape RT004 catches within
+       one file, generalized interprocedurally.
+RT503  unbounded compile-shape minting — a call site passing
+       data-dependent shapes (``len()``, ``.shape``/``.ndim``/
+       ``.size`` derived values) straight to a jitted entry.  Every
+       distinct value is a new trace + XLA compile; the PR 12
+       compile-cache contract requires routing through the capacity
+       ladder (``_next_bucket``/``bucket_size``/``bucket_key``)
+       first.  Taint does not survive a function call — the ladder
+       helpers (or any host computation) wash it.  Call sites inside
+       jitted functions are exempt (in-trace shapes are static by
+       construction).
+RT511  static VMEM footprint — for every declared
+       :class:`~repic_tpu.analysis.kernels.KernelContract` with a
+       ``vmem_budget_bytes=``, re-derive the working-set estimate at
+       every ladder rung by executing the (pure-arithmetic) plan
+       function in a sandbox: sum of BlockSpec tiles x dtype width,
+       x2 for double-buffered (gridded vmem) blocks.  Also
+       cross-checks the megakernel's static-demotion envelope: any
+       module declaring ``_FUSED_MAX_DPROD``/``_FUSED_MAX_K``/
+       ``_DEFAULT_TILE_A``/``FUSED_VMEM_BUDGET_BYTES`` has its
+       transient formula re-evaluated at every admitted (K, D)
+       corner, so widening the envelope constants without re-doing
+       the VMEM math fails lint instead of OOMing a pod.
+RT512  declared dispatch budgets — ``@checked`` entries may declare
+       ``dispatch_budget=``; the rule counts the device programs
+       statically reachable along the entry's resolved call graph
+       (the entry itself if jitted, every distinct reachable jitted
+       function, every ``pallas_call`` site in reachable non-jitted
+       code) and fails when the count exceeds the declaration.  The
+       dynamic half is the DISPATCHCHECK sanitizer
+       (:mod:`repic_tpu.analysis.dispatchcheck`), which asserts the
+       same budgets against per-chunk runtime counters.
+
+Like every static pass this imports NO JAX: pure ``ast`` over source
+text (the RT511 sandbox executes only whitelisted constant
+assignments and undecorated arithmetic helpers from the module under
+analysis — any failure degrades to a silent skip, never a crash or a
+guess).  Suppress with ``# repic: noqa[RT5xx]`` on the finding's
+line, its decorator lines, or any continuation line of a multi-line
+call.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins as _builtins
+import math
+
+from repic_tpu.analysis.concurrency import (
+    Program,
+    _FnWalker,
+    _mk,
+    _suppressed,
+    build_program,
+)
+from repic_tpu.analysis.engine import Finding, Rule, dedupe_findings
+from repic_tpu.analysis.kernels import BlockPlan, KernelPlan
+from repic_tpu.analysis.spmd import (
+    _calls_lexical,
+    _closure_from,
+    _stmts_walk,
+)
+
+# -- rule metadata ----------------------------------------------------
+
+
+class RT501DispatchChain(Rule):
+    rule_id = "RT501"
+    severity = "warning"
+    title = (
+        "chain of 3+ jitted programs with no host use between them"
+    )
+    hint = (
+        "fuse the stages into one jitted entry (compose the "
+        "functions inside a single jit, or use the megakernel path) "
+        "so intermediates stay in VMEM instead of re-crossing the "
+        "dispatch boundary; justify an intentional staging ladder "
+        "with # repic: noqa[RT501] and a comment"
+    )
+
+
+class RT502LoopFetchFeedback(Rule):
+    rule_id = "RT502"
+    severity = "warning"
+    title = (
+        "device->host fetch inside a loop feeds back into a device "
+        "call"
+    )
+    hint = (
+        "batch the decision on device (mask/where) or hoist the "
+        "fetch out of the loop: each iteration pays a serialized "
+        "host<->device round trip; a deliberate escalate-and-retry "
+        "loop is justified with # repic: noqa[RT502] and a comment"
+    )
+
+
+class RT503UnbucketedShape(Rule):
+    rule_id = "RT503"
+    severity = "warning"
+    title = (
+        "data-dependent shape passed to a jitted entry without the "
+        "capacity ladder"
+    )
+    hint = (
+        "route the value through _next_bucket/bucket_size/bucket_key "
+        "before it reaches a jitted call: every distinct value is a "
+        "fresh trace + XLA compile (PR 12 compile-cache contract)"
+    )
+
+
+class RT511VmemBudget(Rule):
+    rule_id = "RT511"
+    severity = "error"
+    title = (
+        "kernel working set exceeds its declared vmem_budget_bytes "
+        "(or the fused envelope admits a point over budget)"
+    )
+    hint = (
+        "shrink the BlockSpec tiles (or raise vmem_budget_bytes with "
+        "the measured justification); for the envelope check, "
+        "re-derive the transient formula in ops/megakernel.py before "
+        "widening _FUSED_MAX_* constants"
+    )
+
+
+class RT512DispatchBudget(Rule):
+    rule_id = "RT512"
+    severity = "error"
+    title = (
+        "reachable device-program launches exceed the entry's "
+        "declared dispatch_budget"
+    )
+    hint = (
+        "fuse or gate the extra programs (one chunk should be one "
+        "launch plus one fetch in steady state), or raise "
+        "dispatch_budget= with a comment explaining the extra "
+        "dispatches; DISPATCHCHECK asserts the same budget at "
+        "runtime"
+    )
+
+
+COST_RULES = {
+    r.rule_id: r
+    for r in (
+        RT501DispatchChain,
+        RT502LoopFetchFeedback,
+        RT503UnbucketedShape,
+        RT511VmemBudget,
+        RT512DispatchBudget,
+    )
+}
+
+# -- canonical names --------------------------------------------------
+
+#: fully-resolved device->host fetch calls
+FETCH_CALLS = {
+    "numpy.asarray": "np.asarray()",
+    "numpy.array": "np.array()",
+    "jax.device_get": "jax.device_get()",
+}
+
+#: attribute tails that force a device->host transfer
+FETCH_ATTR_TAILS = {"item", "tolist"}
+
+#: builtin casts that are fetches ONLY when applied to device values
+FETCH_CASTS = {"float", "int", "bool"}
+
+#: capacity-ladder call tails that wash shape taint (RT503) — listed
+#: for documentation; the pass is stricter: NO call result carries
+#: shape taint, so any host computation (including these) washes it
+LADDER_TAILS = {"_next_bucket", "bucket_size", "bucket_key"}
+
+#: dtype -> bytes per element for the RT511 estimator
+DTYPE_WIDTH = {
+    "float64": 8, "int64": 8, "uint64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "bfloat16": 2, "float16": 2, "int16": 2, "uint16": 2,
+    "bool": 1, "int8": 1, "uint8": 1,
+}
+
+#: the megakernel static-demotion envelope constants; a module
+#: defining ALL of these gets the RT511 transient cross-check
+ENVELOPE_NAMES = (
+    "_FUSED_MAX_DPROD",
+    "_FUSED_MAX_K",
+    "_DEFAULT_TILE_A",
+    "FUSED_VMEM_BUDGET_BYTES",
+)
+
+#: builtins the RT511 sandbox exposes to exec'd plan helpers
+_SANDBOX_BUILTINS = {
+    n: getattr(_builtins, n)
+    for n in (
+        "min", "max", "abs", "len", "range", "int", "float", "sum",
+        "divmod", "pow", "enumerate", "zip", "tuple", "list", "dict",
+        "set", "sorted", "round", "bool",
+    )
+}
+
+_CHAIN_THRESHOLD = 3  # RT501: flag the 3rd consecutive program
+
+
+# -- jitted-function / device-call discovery --------------------------
+
+
+class _Ctx:
+    """Program-wide device-dispatch facts shared by the RT5xx rules."""
+
+    def __init__(self):
+        self.jitted_fn_ids: set[int] = set()   # id(FunctionInfo)
+        self.module_jit_names: dict[int, set] = {}  # id(mod) -> names
+        self.local_jit_names: dict[int, set] = {}   # id(fn) -> names
+        self.dispatch_reach: dict[int, str] = {}    # fid -> witness
+        self.budgeted: list[tuple] = []  # (fn, budget, kw node)
+        self.kernel_contracts: list[tuple] = []  # (fn, KC call node)
+
+
+def _resolved(mod, node) -> str:
+    return mod.imports.resolve(node) or ""
+
+
+def _fn_is_jitted(fn) -> bool:
+    """Lexically jit-decorated: ``@jax.jit`` or
+    ``@functools.partial(jax.jit, ...)``."""
+    for dec in getattr(fn.node, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        dotted = _resolved(fn.module, target)
+        if dotted == "jax.jit":
+            return True
+        if (
+            isinstance(dec, ast.Call)
+            and dotted == "functools.partial"
+            and dec.args
+            and _resolved(fn.module, dec.args[0]) == "jax.jit"
+        ):
+            return True
+    return False
+
+
+def _build_ctx(program: Program, walkers) -> _Ctx:
+    ctx = _Ctx()
+    for fn in program.functions:
+        if _fn_is_jitted(fn):
+            ctx.jitted_fn_ids.add(id(fn))
+    for mod in program.modules:
+        names = set()
+        for stmt in mod.tree.body:
+            if not (
+                isinstance(stmt, ast.Assign)
+                and isinstance(stmt.value, ast.Call)
+                and _resolved(mod, stmt.value.func) == "jax.jit"
+            ):
+                continue
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+            # name = jax.jit(fn): the wrapped function is jitted too
+            if stmt.value.args and isinstance(
+                stmt.value.args[0], ast.Name
+            ):
+                wrapped = mod.functions.get(stmt.value.args[0].id)
+                if wrapped is not None:
+                    ctx.jitted_fn_ids.add(id(wrapped))
+        ctx.module_jit_names[id(mod)] = names
+    for fn in program.functions:
+        local = set()
+        for node in _stmts_walk(fn.node.body):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _resolved(fn.module, node.value.func) == "jax.jit"
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        local.add(t.id)
+        ctx.local_jit_names[id(fn)] = local
+    _collect_contracts(program, ctx)
+    ctx.dispatch_reach = _dispatch_reach(program, walkers, ctx)
+    return ctx
+
+
+def _device_call_kind(walker, call: ast.Call, ctx: _Ctx):
+    """'jit' when the call launches a jitted program, 'pallas' for a
+    pallas_call invocation, else None.  Conservative: an
+    unresolvable callee is never a device call."""
+    func = call.func
+    if isinstance(func, ast.Call):
+        # jax.jit(f)(args) / pl.pallas_call(...)(operands)
+        inner = _resolved(walker.mod, func.func)
+        if inner == "jax.jit":
+            return "jit"
+        tail = (
+            func.func.attr
+            if isinstance(func.func, ast.Attribute)
+            else inner.rsplit(".", 1)[-1]
+        )
+        if (
+            inner == "jax.experimental.pallas.pallas_call"
+            or tail == "pallas_call"
+        ):
+            return "pallas"
+        return None
+    dotted = _resolved(walker.mod, func)
+    tail = (
+        func.attr
+        if isinstance(func, ast.Attribute)
+        else dotted.rsplit(".", 1)[-1]
+    )
+    if (
+        dotted == "jax.experimental.pallas.pallas_call"
+        or tail == "pallas_call"
+    ):
+        return "pallas"
+    if isinstance(func, ast.Name):
+        if func.id in ctx.local_jit_names.get(id(walker.fn), ()):
+            return "jit"
+        if func.id in ctx.module_jit_names.get(id(walker.mod), ()):
+            return "jit"
+    callee = walker.resolve_callee(func)
+    if callee is not None and id(callee) in ctx.jitted_fn_ids:
+        return "jit"
+    return None
+
+
+def _fn_has_device_use(walker, ctx: _Ctx) -> bool:
+    """Direct evidence this function launches (or builds) a device
+    program: a device call, or a bare ``jax.jit(...)`` wrap."""
+    for call in _calls_lexical(walker.fn.node.body):
+        if _device_call_kind(walker, call, ctx) is not None:
+            return True
+        if _resolved(walker.mod, call.func) == "jax.jit":
+            return True
+    return False
+
+
+def _dispatch_reach(program: Program, walkers, ctx: _Ctx) -> dict:
+    """fid -> witness chain for every function that reaches a device
+    dispatch through resolved callees (the RT40x fixed-point shape)."""
+    reach: dict[int, str] = {}
+    for fn in program.functions:
+        if _fn_has_device_use(walkers[id(fn)], ctx):
+            reach[id(fn)] = fn.qual
+    callers: dict[int, list] = {}
+    for fn, callee, _node, _held in program.calls:
+        callers.setdefault(id(fn), []).append((fn, callee))
+    for _ in range(12):
+        changed = False
+        for fid, pairs in callers.items():
+            if fid in reach:
+                continue
+            for fn, callee in pairs:
+                got = reach.get(id(callee))
+                if got is not None:
+                    reach[fid] = f"{fn.qual} -> {got}"
+                    changed = True
+                    break
+        if not changed:
+            break
+    return reach
+
+
+# -- fetch detection (shared by RT501/RT502) --------------------------
+
+
+def _fetch_desc(walker, call: ast.Call, device_names) -> str | None:
+    """Reason string when ``call`` is a device->host fetch.  Builtin
+    casts count only when their argument depends on a device value
+    (``device_names``) — ``float("0.5")`` is not a transfer."""
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr in FETCH_ATTR_TAILS:
+        return f".{func.attr}()"
+    dotted = _resolved(walker.mod, func)
+    if dotted in FETCH_CALLS:
+        return FETCH_CALLS[dotted]
+    if isinstance(func, ast.Name) and func.id in FETCH_CASTS:
+        for arg in call.args:
+            for nm in ast.walk(arg):
+                if isinstance(nm, ast.Name) and nm.id in device_names:
+                    return f"{func.id}() on device value"
+    return None
+
+
+# -- RT501: dispatch chains -------------------------------------------
+
+
+def _expr_chain_depth(walker, expr, depth, ctx) -> int:
+    """Dispatch-chain depth of ``expr``: how many consecutive device
+    programs already fed into it (0 = host data)."""
+    if isinstance(expr, ast.Name):
+        return depth.get(expr.id, 0)
+    if isinstance(expr, ast.Call):
+        inner = max(
+            (
+                _expr_chain_depth(walker, a, depth, ctx)
+                for a in list(expr.args)
+                + [k.value for k in expr.keywords]
+            ),
+            default=0,
+        )
+        if _device_call_kind(walker, expr, ctx) is not None:
+            return 1 + inner
+        return 0  # host call: its result is host data
+    return max(
+        (
+            _expr_chain_depth(walker, c, depth, ctx)
+            for c in ast.iter_child_nodes(expr)
+        ),
+        default=0,
+    )
+
+
+def _assign_parts(stmt):
+    if isinstance(stmt, ast.Assign):
+        return stmt.targets, stmt.value
+    if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        return [stmt.target], stmt.value
+    return None, None
+
+
+def _target_names(targets):
+    out = []
+    for t in targets or ():
+        for nm in ast.walk(t):
+            if isinstance(nm, ast.Name):
+                out.append(nm.id)
+    return out
+
+
+def _rt501(program: Program, walkers, ctx: _Ctx):
+    findings = []
+    for fn in program.functions:
+        if id(fn) in ctx.jitted_fn_ids:
+            continue  # inside a trace, composition is fusion
+        w = walkers[id(fn)]
+        stmts = [
+            n
+            for n in _stmts_walk(fn.node.body)
+            if isinstance(
+                n, (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Expr)
+            )
+        ]
+        stmts.sort(key=lambda n: (n.lineno, n.col_offset))
+        depth: dict[str, int] = {}
+        for st in stmts:
+            # a host fetch of an intermediate breaks its chain: the
+            # host genuinely consumed the value
+            for sub in ast.walk(st):
+                if isinstance(sub, ast.Call) and _fetch_desc(
+                    w, sub, depth
+                ):
+                    for nm in ast.walk(sub):
+                        if isinstance(nm, ast.Name):
+                            depth.pop(nm.id, None)
+            targets, value = _assign_parts(st)
+            if value is None or not isinstance(value, ast.Call):
+                for name in _target_names(targets):
+                    depth.pop(name, None)
+                continue
+            kind = _device_call_kind(w, value, ctx)
+            if kind is None:
+                for name in _target_names(targets):
+                    depth.pop(name, None)
+                continue
+            d = 1 + max(
+                (
+                    _expr_chain_depth(w, a, depth, ctx)
+                    for a in list(value.args)
+                    + [k.value for k in value.keywords]
+                ),
+                default=0,
+            )
+            if d >= _CHAIN_THRESHOLD:
+                findings.append(
+                    _mk(
+                        RT501DispatchChain,
+                        w.mod.path,
+                        value,
+                        f"{fn.qual} launches device program #{d} of a "
+                        f"chain whose intermediates never touch the "
+                        f"host: each hand-off re-crosses the dispatch "
+                        f"boundary a fused program would keep in VMEM",
+                    )
+                )
+            for name in _target_names(targets):
+                depth[name] = d
+    return findings
+
+
+# -- RT502: loop fetch feedback ---------------------------------------
+
+
+def _device_tainted_names(walker, ctx: _Ctx) -> set:
+    """Names assigned from device-call results (flow-insensitive)."""
+    out: set[str] = set()
+    for _ in range(2):
+        for node in _stmts_walk(walker.fn.node.body):
+            targets, value = _assign_parts(node)
+            if value is None:
+                continue
+            hit = False
+            for sub in ast.walk(value):
+                if isinstance(sub, ast.Call) and _device_call_kind(
+                    walker, sub, ctx
+                ):
+                    hit = True
+                elif isinstance(sub, ast.Name) and sub.id in out:
+                    hit = True
+            if hit:
+                out.update(_target_names(targets))
+    return out
+
+
+def _first_fetch_in(walker, expr, device_names, fetch_by_name):
+    """``(desc, node)`` of the first fetch this expression depends
+    on, via a direct fetch call or an already-fetch-tainted name."""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call):
+            desc = _fetch_desc(walker, sub, device_names)
+            if desc is not None:
+                return desc, sub
+        elif isinstance(sub, ast.Name) and sub.id in fetch_by_name:
+            return fetch_by_name[sub.id]
+    return None
+
+
+def _rt502(program: Program, walkers, ctx: _Ctx):
+    findings = []
+    for fn in program.functions:
+        if id(fn) in ctx.jitted_fn_ids:
+            continue
+        w = walkers[id(fn)]
+        device_names = _device_tainted_names(w, ctx)
+        loops = [
+            n
+            for n in _stmts_walk(fn.node.body)
+            if isinstance(n, (ast.For, ast.While))
+        ]
+        flagged: set[int] = set()
+        for loop in loops:
+            fetch_by_name: dict[str, tuple] = {}
+            for _ in range(2):
+                for st in _stmts_walk(loop.body):
+                    targets, value = _assign_parts(st)
+                    if value is None:
+                        continue
+                    hit = _first_fetch_in(
+                        w, value, device_names, fetch_by_name
+                    )
+                    if hit is None:
+                        continue
+                    for name in _target_names(targets):
+                        fetch_by_name.setdefault(name, hit)
+            if not fetch_by_name and not any(
+                isinstance(s, ast.Call)
+                and _fetch_desc(w, s, device_names)
+                for s in _stmts_walk(loop.body)
+            ):
+                continue
+            for call in _calls_lexical(loop.body):
+                kind = _device_call_kind(w, call, ctx)
+                chain = None
+                if kind is None:
+                    callee = w.resolve_callee(call.func)
+                    if callee is not None:
+                        chain = ctx.dispatch_reach.get(id(callee))
+                    if chain is None:
+                        continue
+                for arg in list(call.args) + [
+                    k.value for k in call.keywords
+                ]:
+                    hit = _first_fetch_in(
+                        w, arg, device_names, fetch_by_name
+                    )
+                    if hit is None:
+                        continue
+                    desc, node = hit
+                    if id(node) in flagged:
+                        continue
+                    flagged.add(id(node))
+                    via = (
+                        f"device-dispatching call (via {chain})"
+                        if chain
+                        else "device call"
+                    )
+                    findings.append(
+                        _mk(
+                            RT502LoopFetchFeedback,
+                            w.mod.path,
+                            node,
+                            f"{desc} inside a loop in {fn.qual} feeds "
+                            f"back into a {via} at line "
+                            f"{call.lineno}: every iteration pays a "
+                            f"serialized host<->device round trip",
+                        )
+                    )
+    return findings
+
+
+# -- RT503: unbucketed compile shapes ---------------------------------
+
+
+def _shape_source(walker, expr) -> str | None:
+    """Reason when ``expr`` is a direct data-dependent-shape source."""
+    if (
+        isinstance(expr, ast.Call)
+        and isinstance(expr.func, ast.Name)
+        and expr.func.id == "len"
+    ):
+        return "len()"
+    if isinstance(expr, ast.Attribute) and expr.attr in (
+        "shape", "ndim", "size",
+    ):
+        return f".{expr.attr}"
+    return None
+
+
+def _shape_taint_map(walker) -> dict:
+    """Name -> source description.  Taint flows through arithmetic
+    and tuple unpacking but NEVER through a call result — the
+    capacity-ladder helpers (and any other host computation) wash it
+    by construction."""
+    tainted: dict[str, str] = {}
+
+    def expr_taint(expr):
+        stack = [expr]
+        while stack:
+            n = stack.pop()
+            src = _shape_source(walker, n)
+            if src is not None:
+                return src
+            if isinstance(n, ast.Call):
+                continue  # call results are washed
+            if isinstance(n, ast.Name) and n.id in tainted:
+                return tainted[n.id]
+            stack.extend(ast.iter_child_nodes(n))
+        return None
+
+    for _ in range(2):
+        for node in _stmts_walk(walker.fn.node.body):
+            targets, value = _assign_parts(node)
+            if value is None:
+                continue
+            src = expr_taint(value)
+            if src is None:
+                continue
+            for name in _target_names(targets):
+                tainted.setdefault(name, src)
+    return tainted
+
+
+def _rt503(program: Program, walkers, ctx: _Ctx):
+    findings = []
+    for fn in program.functions:
+        if id(fn) in ctx.jitted_fn_ids:
+            continue  # in-trace shapes are static by construction
+        w = walkers[id(fn)]
+        tainted = _shape_taint_map(w)
+
+        def arg_taint(expr, tainted=tainted, w=w):
+            stack = [expr]
+            while stack:
+                n = stack.pop()
+                src = _shape_source(w, n)
+                if src is not None:
+                    return src, n
+                if isinstance(n, ast.Call):
+                    continue  # washed
+                if isinstance(n, ast.Name) and n.id in tainted:
+                    return tainted[n.id], n
+                stack.extend(ast.iter_child_nodes(n))
+            return None
+
+        for call in _calls_lexical(fn.node.body):
+            if _device_call_kind(w, call, ctx) != "jit":
+                continue
+            for arg in list(call.args) + [
+                k.value for k in call.keywords
+            ]:
+                hit = arg_taint(arg)
+                if hit is None:
+                    continue
+                src, _node = hit
+                findings.append(
+                    _mk(
+                        RT503UnbucketedShape,
+                        w.mod.path,
+                        call,
+                        f"{fn.qual} passes a data-dependent value "
+                        f"(from {src}) to a jitted entry without "
+                        f"routing through the capacity ladder: every "
+                        f"distinct value mints a fresh trace + "
+                        f"compile",
+                    )
+                )
+                break  # one finding per call site
+    return findings
+
+
+# -- RT511: static VMEM footprint -------------------------------------
+
+_CONST_NODES = (
+    ast.Constant, ast.Tuple, ast.List, ast.Dict, ast.Set,
+    ast.BinOp, ast.UnaryOp, ast.Name, ast.Load, ast.Store,
+    ast.operator, ast.unaryop,
+)
+
+
+def _const_expr_ok(node, env) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            if n.id not in env:
+                return False
+        elif not isinstance(n, _CONST_NODES):
+            return False
+    return True
+
+
+def _module_sandbox(mod):
+    """Execute the module's whitelisted constants and undecorated
+    arithmetic helpers in a sandbox namespace.  Returns ``(env,
+    const_nodes)`` where const_nodes maps constant name -> its Assign
+    node (finding anchors)."""
+    env: dict = {
+        "__builtins__": dict(_SANDBOX_BUILTINS),
+        "BlockPlan": BlockPlan,
+        "KernelPlan": KernelPlan,
+    }
+    const_nodes: dict[str, ast.AST] = {}
+    for stmt in mod.tree.body:
+        if isinstance(stmt, ast.Assign) and _const_expr_ok(
+            stmt.value, env
+        ):
+            try:
+                val = eval(  # noqa: S307 — whitelisted arith only
+                    compile(
+                        ast.Expression(stmt.value), mod.path, "eval"
+                    ),
+                    env,
+                )
+            except Exception:
+                continue
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    env[t.id] = val
+                    const_nodes[t.id] = stmt
+        elif isinstance(stmt, ast.FunctionDef) and not (
+            stmt.decorator_list
+        ):
+            try:
+                exec(  # noqa: S102 — def only; calls are sandboxed
+                    compile(
+                        ast.Module(body=[stmt], type_ignores=[]),
+                        mod.path,
+                        "exec",
+                    ),
+                    env,
+                )
+            except Exception:
+                continue
+    return env, const_nodes
+
+
+def _collect_contracts(program: Program, ctx: _Ctx) -> None:
+    """Find ``@checked(Contract(...))`` decorations, recording
+    ``kernel=KernelContract(...)`` call nodes and literal
+    ``dispatch_budget=`` declarations on the ctx."""
+    for fn in program.functions:
+        for dec in getattr(fn.node, "decorator_list", ()):
+            if not isinstance(dec, ast.Call):
+                continue
+            dotted = _resolved(fn.module, dec.func)
+            if not (
+                dotted == "checked" or dotted.endswith(".checked")
+            ):
+                continue
+            for arg in list(dec.args) + [
+                k.value for k in dec.keywords
+            ]:
+                if not isinstance(arg, ast.Call):
+                    continue
+                for kw in arg.keywords:
+                    if kw.arg == "kernel" and isinstance(
+                        kw.value, ast.Call
+                    ):
+                        ctx.kernel_contracts.append((fn, kw.value))
+                    elif (
+                        kw.arg == "dispatch_budget"
+                        and isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, int)
+                    ):
+                        ctx.budgeted.append(
+                            (fn, kw.value.value, kw.value)
+                        )
+
+
+def _plan_estimate(plan: KernelPlan) -> int:
+    """Static working-set bytes: every block's tile (or whole padded
+    array) x dtype width, x2 for double-buffered blocks (gridded
+    vmem tiles stream while the next tile loads)."""
+    grid_total = math.prod(plan.grid) if plan.grid else 1
+    total = 0
+    for bp in tuple(plan.in_blocks) + tuple(plan.out_blocks):
+        shape = (
+            bp.block_shape
+            if bp.block_shape is not None
+            else bp.padded_shape
+        )
+        nbytes = math.prod(shape) * DTYPE_WIDTH.get(bp.dtype, 4)
+        if (
+            bp.memory_space == "vmem"
+            and bp.block_shape is not None
+            and grid_total > 1
+        ):
+            nbytes *= 2
+        total += nbytes
+    return total
+
+
+def _eval_in_env(mod, env, node):
+    try:
+        return eval(  # noqa: S307 — module-local sandbox
+            compile(ast.Expression(node), mod.path, "eval"), env
+        )
+    except Exception:
+        return None
+
+
+def _rt511_contracts(program: Program, ctx: _Ctx):
+    findings = []
+    for fn, kc in ctx.kernel_contracts:
+        mod = fn.module
+        kws = {k.arg: k.value for k in kc.keywords}
+        if "vmem_budget_bytes" not in kws:
+            continue
+        env, _nodes = _module_sandbox(mod)
+        budget = _eval_in_env(mod, env, kws["vmem_budget_bytes"])
+        plan_fn = (
+            _eval_in_env(mod, env, kws["plan"])
+            if "plan" in kws
+            else None
+        )
+        ladder = (
+            _eval_in_env(mod, env, kws["ladder"])
+            if "ladder" in kws
+            else None
+        )
+        if (
+            not isinstance(budget, int)
+            or not callable(plan_fn)
+            or not ladder
+        ):
+            continue  # conservative: unevaluable contract is skipped
+        for dims in ladder:
+            try:
+                plan = plan_fn(dict(dims))
+                estimate = _plan_estimate(plan)
+            except Exception:
+                continue
+            if estimate > budget:
+                findings.append(
+                    _mk(
+                        RT511VmemBudget,
+                        mod.path,
+                        kc,
+                        f"{fn.qual} kernel working set at ladder rung "
+                        f"{dims} is ~{estimate} B (tiles x dtype x "
+                        f"double-buffer), over the declared "
+                        f"vmem_budget_bytes={budget}",
+                    )
+                )
+                break  # one finding per contract
+    return findings
+
+
+def _envelope_worst_corner(max_dprod, max_k, tile_a):
+    """``(k, d, transient_bytes)`` of the worst (K, D) corner the
+    fused envelope admits: TA x D^(K-1) x (E + 2K + 4) x 4 B where
+    E = K(K-1)/2 pair columns (must match ops/cliques._edge_pairs)."""
+    worst = (0, 0, 0)
+    for k in range(2, max_k + 1):
+        d, dprod = 2, 2 ** (k - 1)
+        if dprod > max_dprod:
+            continue
+        while (d + 1) ** (k - 1) <= max_dprod:
+            d += 1
+        dprod = d ** (k - 1)
+        terms = k * (k - 1) // 2 + 2 * k + 4
+        transient = tile_a * dprod * terms * 4
+        if transient > worst[2]:
+            worst = (k, d, transient)
+    return worst
+
+
+def _rt511_envelope(program: Program):
+    findings = []
+    for mod in program.modules:
+        env, const_nodes = _module_sandbox(mod)
+        if not all(n in env for n in ENVELOPE_NAMES):
+            continue
+        try:
+            k, d, transient = _envelope_worst_corner(
+                int(env["_FUSED_MAX_DPROD"]),
+                int(env["_FUSED_MAX_K"]),
+                int(env["_DEFAULT_TILE_A"]),
+            )
+            budget = int(env["FUSED_VMEM_BUDGET_BYTES"])
+        except Exception:
+            continue
+        if transient > budget:
+            anchor = const_nodes.get(
+                "FUSED_VMEM_BUDGET_BYTES", mod.tree
+            )
+            findings.append(
+                _mk(
+                    RT511VmemBudget,
+                    mod.path,
+                    anchor,
+                    f"the fused envelope admits K={k}, D={d} with a "
+                    f"~{transient} B VMEM transient, over "
+                    f"FUSED_VMEM_BUDGET_BYTES={budget}: re-derive "
+                    f"the budget math before widening _FUSED_MAX_* "
+                    f"constants",
+                )
+            )
+    return findings
+
+
+# -- RT512: declared dispatch budgets ---------------------------------
+
+
+def _rt512(program: Program, walkers, ctx: _Ctx):
+    findings = []
+    for fn, budget, _node in ctx.budgeted:
+        closure = _closure_from(program, [fn])
+        jitted = []
+        pallas_sites = 0
+        for reached, _chain in closure.values():
+            if id(reached) in ctx.jitted_fn_ids:
+                if reached is not fn:
+                    jitted.append(reached.qual)
+                continue
+            # pallas_call sites in NON-jitted reachable code each
+            # launch their own program (inside a jit they are part
+            # of the enclosing program)
+            for call in _calls_lexical(reached.node.body):
+                if (
+                    _device_call_kind(
+                        walkers[id(reached)], call, ctx
+                    )
+                    == "pallas"
+                ):
+                    pallas_sites += 1
+        count = (
+            (1 if id(fn) in ctx.jitted_fn_ids else 0)
+            + len(set(jitted))
+            + pallas_sites
+        )
+        if count > budget:
+            via = ", ".join(sorted(set(jitted))[:6]) or "none"
+            findings.append(
+                _mk(
+                    RT512DispatchBudget,
+                    fn.module.path,
+                    fn.node,
+                    f"{fn.qual} declares dispatch_budget={budget} "
+                    f"but its call graph statically reaches {count} "
+                    f"device-program launches (jitted callees: "
+                    f"{via}; pallas sites outside jit: "
+                    f"{pallas_sites})",
+                )
+            )
+    return findings
+
+
+# -- entry point ------------------------------------------------------
+
+
+def run_cost(paths, select=None) -> list[Finding]:
+    """Run the RT5xx whole-program pass; returns filtered findings."""
+    program, errors = build_program(paths)
+    walkers = {
+        id(fn): _FnWalker(program, fn) for fn in program.functions
+    }
+    ctx = _build_ctx(program, walkers)
+    raw = (
+        _rt501(program, walkers, ctx)
+        + _rt502(program, walkers, ctx)
+        + _rt503(program, walkers, ctx)
+        + _rt511_contracts(program, ctx)
+        + _rt511_envelope(program)
+        + _rt512(program, walkers, ctx)
+    )
+    findings = list(errors)
+    for f, extra_lines in raw:
+        if select and f.rule not in select:
+            continue
+        mod = program.by_path.get(f.path)
+        if mod is not None and _suppressed(mod, f, extra_lines):
+            continue
+        findings.append(f)
+    if select:
+        findings = [
+            f
+            for f in findings
+            if f.rule in select or f.rule == "RT000"
+        ]
+    return dedupe_findings(findings)
+
+
+def cost_summary(paths) -> dict:
+    """Non-vacuity surface: what the pass actually SAW.  A tree where
+    these counts drop to zero means the pass went blind (an import
+    drifted, a decorator was renamed), not that the tree is clean —
+    pinned by tests/test_analysis_cost.py against the real tree."""
+    program, _errors = build_program(paths)
+    walkers = {
+        id(fn): _FnWalker(program, fn) for fn in program.functions
+    }
+    ctx = _build_ctx(program, walkers)
+    envelope_modules = 0
+    for mod in program.modules:
+        env, _nodes = _module_sandbox(mod)
+        if all(n in env for n in ENVELOPE_NAMES):
+            envelope_modules += 1
+    return {
+        "functions": len(program.functions),
+        "jitted_functions": len(ctx.jitted_fn_ids),
+        "budgeted_entries": len(ctx.budgeted),
+        "kernel_contracts": len(ctx.kernel_contracts),
+        "envelope_modules": envelope_modules,
+        "dispatch_reaching": len(ctx.dispatch_reach),
+    }

@@ -1,0 +1,202 @@
+"""DISPATCHCHECK: the runtime device-dispatch budget sanitizer.
+
+The static RT5xx pass (:mod:`repic_tpu.analysis.cost`) counts the
+device programs an entry's call graph CAN launch; it cannot see how
+many a chunk actually costs at run time — escalation retries, probe
+fetches, and packed-output transfers are data- and config-dependent.
+DISPATCHCHECK is the dynamic half, mirroring LOCKCHECK and
+KERNELCHECK (:mod:`repic_tpu.analysis.lockcheck` /
+:mod:`repic_tpu.analysis.kernelcheck`): opt in with
+``REPIC_TPU_DISPATCHCHECK=1`` and every accepted consensus batch
+attempt reports its dispatch window — instrumented program launches
+(:func:`repic_tpu.telemetry.probes.note_dispatch`) plus host<->device
+fetch round trips (:func:`~repic_tpu.telemetry.probes.record_transfer`)
+— against the ``dispatch_budget=`` its ``@checked`` entry declares
+(:class:`repic_tpu.analysis.contracts.Contract`): the fused
+megakernel chunk must stay <= 3, the staged chunk <= 5.  The window
+covers the ACCEPTED attempt only — first-visit capacity probes and
+escalation retries are excluded by construction (the window re-marks
+at each attempt start), so the budget measures the steady-state cost
+the round-5 breakdown showed is RTT-bound.
+
+Like the other sanitizers, recording NEVER raises into the
+instrumented path: violations accumulate in a module-level list and
+the pytest hooks in ``tests/conftest.py`` print the report in a
+terminal section and fail the session.  A per-test scope
+(:func:`test_scope`) labels each violation with the test that drove
+the chunk, so a red session names its culprit.
+
+Usage::
+
+    REPIC_TPU_DISPATCHCHECK=1 pytest tests/test_megakernel.py
+
+or programmatically::
+
+    from repic_tpu.analysis import dispatchcheck
+    dispatchcheck.install()
+    ... run consensus ...
+    assert not dispatchcheck.violations(), dispatchcheck.report_text()
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+#: opt-in switch, mirroring REPIC_TPU_LOCKCHECK / _KERNELCHECK
+ENV_VAR = "REPIC_TPU_DISPATCHCHECK"
+
+_installed = False
+_violations: list[dict] = []
+_windows: list[dict] = []     # every closed window, for tests/report
+_current_test: str | None = None
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_VAR, "") == "1"
+
+
+def install() -> bool:
+    """Arm the sanitizer.  Idempotent; returns True when active."""
+    global _installed
+    _installed = True
+    return True
+
+
+def uninstall() -> None:
+    global _installed
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def maybe_install_from_env() -> bool:
+    """Install iff ``REPIC_TPU_DISPATCHCHECK=1`` (conftest)."""
+    if enabled():
+        install()
+        return True
+    return False
+
+
+def _record(kind: str, entry: str, detail: str) -> None:
+    _violations.append(
+        {
+            "kind": kind,
+            "entry": entry,
+            "detail": detail,
+            "test": _current_test,
+        }
+    )
+
+
+def budget_for(entry: str):
+    """The ``dispatch_budget`` the registered ``@checked`` entry
+    declares, or None (unregistered entry / no budget declared)."""
+    from repic_tpu.analysis import contracts
+
+    got = contracts.registry().get(entry)
+    if got is None:
+        return None
+    return getattr(got.contract, "dispatch_budget", None)
+
+
+def note_chunk(entry: str, dispatches: int, **context) -> None:
+    """Report one accepted chunk window of ``dispatches`` launches
+    (instrumented dispatches + fetch round trips) attributed to the
+    ``@checked`` entry ``entry`` (canonical dotted name).
+
+    Called by the consensus batch path when the sanitizer is armed;
+    never raises.  A window over the entry's declared
+    ``dispatch_budget`` records a violation; windows for entries with
+    no budget are recorded but never violate.
+    """
+    if not _installed:
+        return
+    try:
+        budget = budget_for(entry)
+    except Exception:  # pragma: no cover - registry import failure
+        budget = None
+    _windows.append(
+        {
+            "entry": entry,
+            "dispatches": int(dispatches),
+            "budget": budget,
+            "test": _current_test,
+            **context,
+        }
+    )
+    if budget is not None and dispatches > budget:
+        _record(
+            "dispatch-budget-exceeded",
+            entry,
+            f"chunk cost {dispatches} device dispatches+fetches, "
+            f"budget is {budget}"
+            + (f" ({context})" if context else ""),
+        )
+
+
+def windows() -> list[dict]:
+    """Every window closed while armed (newest last)."""
+    return list(_windows)
+
+
+def violations() -> list[dict]:
+    return list(_violations)
+
+
+def reset() -> None:
+    """Clear recorded windows + violations (test isolation)."""
+    _violations.clear()
+    _windows.clear()
+
+
+@contextlib.contextmanager
+def scoped():
+    """Isolate violations/windows + installed flag (unit tests).
+
+    DISPATCHCHECK's own tests deliberately report over-budget
+    windows; without isolation those recordings would trip the
+    session-level gate in ``tests/conftest.py``."""
+    global _installed
+    snap_v, snap_w = list(_violations), list(_windows)
+    was = _installed
+    try:
+        yield
+    finally:
+        _violations[:] = snap_v
+        _windows[:] = snap_w
+        _installed = was
+
+
+@contextlib.contextmanager
+def test_scope(label: str):
+    """Tag windows/violations recorded inside with ``label`` (the
+    pytest nodeid) — armed sessions attribute each over-budget chunk
+    to the test that drove it."""
+    global _current_test
+    prev = _current_test
+    _current_test = label
+    try:
+        yield
+    finally:
+        _current_test = prev
+
+
+def report_text() -> str:
+    """Human-readable violation report (printed by the pytest hook)."""
+    out = []
+    for v in violations():
+        where = f" [{v['test']}]" if v.get("test") else ""
+        out.append(
+            f"DISPATCHCHECK {v['kind']} [{v['entry']}]{where}: "
+            f"{v['detail']}"
+        )
+    if not out:
+        n = len(_windows)
+        return (
+            f"DISPATCHCHECK: no violations "
+            f"({n} chunk window(s) within budget)"
+        )
+    return "\n".join(out)

@@ -154,6 +154,12 @@ class KernelContract:
         min_tile: minimum TPU tile for rank>=2 VMEM blocks.
         max_probe_points: full-grid index-map enumeration cap; larger
             grids probe corners + edges only.
+        vmem_budget_bytes: declared VMEM ceiling for one grid step's
+            resident blocks.  The RT511 static estimator sums every
+            BlockSpec tile (x dtype width, x2 for the pipelined
+            double buffer on moving VMEM blocks) across the
+            contract's shape ladder and fails the lint when any rung
+            exceeds this.  ``None`` opts out of the estimate.
     """
 
     plan: object
@@ -165,6 +171,7 @@ class KernelContract:
     tol: float = 1e-6
     min_tile: tuple = (8, 128)
     max_probe_points: int = 4096
+    vmem_budget_bytes: int | None = None
 
 
 # -- RT421/RT422/RT424: pure-Python plan validation -------------------

@@ -299,6 +299,7 @@ def _compare(got, want, tol):
         reference=_reference,
         compare=_compare,
         tol=1e-6,
+        vmem_budget_bytes=2 * 2**20,
     ),
 ))
 @functools.partial(

@@ -89,15 +89,27 @@ NEG = -1.0   # select-max mask-out sentinel (any IoU is >= 0)
 
 # Fused-program eligibility envelope: the candidate product is
 # evaluated per anchor tile entirely in VMEM, so its lane width
-# D^(K-1) and the full-row candidate blocks bound what fits.  At the
-# caps, one tile's transient is TA x DPROD x ~(E + 2K + 4) f32
-# = 64 x 4096 x ~17 x 4 B ~= 18 MB of scoped liveness at K=4 — the
-# VMEM budget math in docs/tpu.md; past it the staged path wins.
+# D^(K-1) and the full-row candidate blocks bound what fits.  One
+# tile's transient is TA x DPROD x (E + 2K + 4) f32 with
+# E = K(K-1)/2; the WORST admitted corner is K=5 (D=8, DPROD=4096):
+# 64 x 4096 x 24 x 4 B = 24 MiB of scoped liveness — not the 18 MB
+# K=4 point the original budget math quoted (docs/tpu.md).  Past the
+# envelope the staged path wins.
 _FUSED_MAX_DPROD = 4096
 _FUSED_MAX_N = 8192
 _FUSED_MAX_K = 6
 
 _DEFAULT_TILE_A = 64
+
+#: Declared scoped-VMEM ceiling for one fused anchor tile.  The RT511
+#: static estimator (repic_tpu/analysis/cost.py) re-derives the
+#: transient formula above at every (K, D) corner the eligibility
+#: constants admit and fails `repic-tpu lint --cost` if any corner
+#: exceeds this — so widening _FUSED_MAX_DPROD/_FUSED_MAX_K without
+#: re-doing the budget math is a lint error, not a latent TPU OOM.
+#: 28 MiB = the 24 MiB worst corner plus double-buffered tile
+#: headroom, inside the 128 MB vector memory.
+FUSED_VMEM_BUDGET_BYTES = 28 * 2**20
 
 #: env var forcing the kernel path on non-TPU backends (interpret
 #: mode) — the golden byte-identity tests and operator smoke use it;
@@ -561,7 +573,11 @@ def _compare(got, want, tol):
         reference=_reference,
         compare=_compare,
         tol=0.0,
+        vmem_budget_bytes=2 * 2**20,
     ),
+    # one fused program + the packed-output fetch: a coalesced chunk
+    # must stay within <=3 device dispatches (DISPATCHCHECK budget)
+    dispatch_budget=3,
 ))
 @functools.partial(
     jax.jit,
@@ -786,7 +802,9 @@ def _solve_compare(got, want, tol):
         reference=_solve_reference,
         compare=_solve_compare,
         tol=0.0,
+        vmem_budget_bytes=1 * 2**20,
     ),
+    dispatch_budget=3,
 ))
 @functools.partial(
     jax.jit, static_argnames=("num_vertices", "interpret")

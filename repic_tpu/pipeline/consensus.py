@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from repic_tpu import telemetry
+from repic_tpu.analysis import dispatchcheck
 from repic_tpu.analysis.contracts import Contract, checked, spec
 from repic_tpu.ops.cliques import (
     DEFAULT_THRESHOLD,
@@ -110,6 +111,22 @@ _PROGRAM_MISSES = telemetry.counter(
     "signature (cold path: trace + XLA compile)",
 )
 _PROGRAM_SIGNATURES: set = set()
+
+# The most recent accepted-attempt dispatch window, handed from
+# run_consensus_batch to the chunk loop for journaling.  Thread-local:
+# the prefetch worker runs the whole serial generator on one thread,
+# so producer and consumer always share a slot, while a concurrently
+# embedded second pipeline cannot clobber it.
+_DISPATCH_REPORT = threading.local()
+
+
+def consume_dispatch_report() -> dict | None:
+    """Pop the calling thread's last accepted-attempt dispatch window
+    (entry, dispatches, budget context) recorded by
+    :func:`run_consensus_batch`, or None."""
+    report = getattr(_DISPATCH_REPORT, "report", None)
+    _DISPATCH_REPORT.report = None
+    return report
 
 
 def program_signature(
@@ -224,6 +241,11 @@ class ConsensusResult(NamedTuple):
         "mask": (MICROGRAPH_AXIS,),
     },
     max_trace_variants=4,
+    # Staged chunk budget (RT512 static count + DISPATCHCHECK runtime
+    # assertion): one batched program launch plus the probe (or
+    # packed-output) fetch is the steady state; headroom to 5 covers
+    # the dense-path variants without admitting a per-item ladder.
+    dispatch_budget=5,
 ))
 def consensus_one(
     xy: jax.Array,
@@ -920,6 +942,11 @@ def run_consensus_batch(
         # data drift upward.
         d, cap, cell_cap, pcap = known
     while True:
+        # DISPATCHCHECK window opens here: marks taken at the top of
+        # every attempt mean rejected (escalated) attempts and the
+        # first-visit capacity probes above never count against the
+        # accepted chunk's budget.
+        disp_mark, fetch_mark = tlm_probes.dispatch_counters()
         fn = make_batched_consensus(
             threshold=threshold,
             max_neighbors=d,
@@ -961,6 +988,7 @@ def run_consensus_batch(
             capacity=batch.capacity,
         ):
             res = fn(xy, conf, mask, box_arg)
+            tlm_probes.note_dispatch()
         # The four probes are reduced on device and fetched in ONE
         # transfer: per-scalar fetches each pay a full host<->device
         # round trip (expensive over a tunneled TPU).  In packed mode
@@ -971,7 +999,11 @@ def run_consensus_batch(
             packed = _pack_result(res)
             probes = _packed_probes(packed).max(axis=0)
         else:
-            probes = np.asarray(
+            # The probe fetch FEEDING the next attempt's capacities
+            # is this loop's whole point: escalation happens at most
+            # O(log capacity) times per workload and the steady state
+            # takes exactly one pass (DISPATCHCHECK pins it).
+            probes = np.asarray(  # repic: noqa[RT502]
                 _probe_reduce(
                     res.max_adjacency, res.num_cliques,
                     res.max_cell_count, res.max_partial,
@@ -996,6 +1028,12 @@ def run_consensus_batch(
             note_program_solves(
                 sum(1 for n in batch.names if n)
             )
+        # The entry whose declared dispatch_budget governs this
+        # accepted chunk: the staged program is consensus_one's
+        # contract; a chunk the megakernel actually took (same
+        # envelope + backend test as the trace-time decision) is the
+        # fused entry's tighter budget.
+        dispatch_entry = "repic_tpu.pipeline.consensus.consensus_one"
         if solver == "lp_device_fused":
             # megakernel chunk accounting mirrors the trace-time
             # dispatch decision: the same (K, N, D, grid) envelope
@@ -1015,6 +1053,33 @@ def run_consensus_batch(
                 megakernel.note_fused_chunk(
                     sum(1 for n in batch.names if n)
                 )
+                dispatch_entry = (
+                    "repic_tpu.ops.megakernel.fused_clique_candidates"
+                )
+        # DISPATCHCHECK window closes on the accepted attempt:
+        # instrumented program launches plus host<->device fetch
+        # round trips since this attempt's marks.  The BOX-writing
+        # epilogue fetch in fetch mode is deliberately outside the
+        # window — the budget measures the chunk's solve cost, which
+        # the RTT breakdown showed must stay at one launch + one
+        # fetch in steady state.
+        disp_now, fetch_now = tlm_probes.dispatch_counters()
+        chunk_dispatches = (
+            (disp_now - disp_mark) + (fetch_now - fetch_mark)
+        )
+        _DISPATCH_REPORT.report = {
+            "entry": dispatch_entry,
+            "dispatches": chunk_dispatches,
+            "micrographs": sum(1 for n in batch.names if n),
+            "solver": solver,
+        }
+        if dispatchcheck.installed():
+            dispatchcheck.note_chunk(
+                dispatch_entry,
+                chunk_dispatches,
+                solver=solver,
+                micrographs=sum(1 for n in batch.names if n),
+            )
         # This batch's exact requirement (the probes are true counts
         # once nothing overflows).  Components whose probe is
         # meaningless on this path (cell count off-grid, partials on
@@ -3093,6 +3158,12 @@ def _iter_chunks_serial(
                 faults.inject("io", ckey)
                 res, extras = _execute(cbatch, use_mesh)
             _CHUNKS.inc()
+            # Journal the accepted attempt's dispatch window so an
+            # armed DISPATCHCHECK run (or a post-hoc audit) can read
+            # per-chunk device cost straight off the journal.
+            dreport = consume_dispatch_report()
+            if journal is not None and dreport is not None:
+                journal.record_event("chunk_dispatches", **dreport)
         except Exception as e:  # noqa: BLE001 — routed to the ladder
             kind = classify_error(e)
             if kind == "oom" and chunk > n_dev:

@@ -216,7 +216,10 @@ def run_consensus_giant(
             threshold, d, cap, mesh, grid, cell_cap, pcap
         )
         cs = fn(xy, conf, mask, box_arg)
-        probes = np.asarray(
+        # Same escalate-and-retry discipline as run_consensus_batch:
+        # the probe fetch sizing the next attempt is the documented
+        # rare path, not a per-item ladder.
+        probes = np.asarray(  # repic: noqa[RT502]
             _probe_reduce(
                 cs.max_adjacency, cs.num_valid,
                 cs.max_cell_count, jnp.asarray(cs.max_partial),
@@ -233,8 +236,11 @@ def run_consensus_giant(
     # single array.  (The previous host-side version fetched eight
     # arrays separately and re-uploaded the solve inputs — ~9
     # serialized round trips per giant micrograph over the tunnel.)
+    # k is the picker count — a config constant bounded by the
+    # ensemble size, not an unbounded data shape; at most one compile
+    # per ensemble geometry (n_max is already rounded per stripe).
     packed = np.asarray(
-        _finalize_giant(
+        _finalize_giant(  # repic: noqa[RT503]
             cs.member_idx, cs.valid, cs.w, cs.confidence,
             cs.rep_xy, cs.rep_slot, cs.num_valid,
             jnp.asarray(l2g),

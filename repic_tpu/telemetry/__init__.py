@@ -58,7 +58,10 @@ from repic_tpu.telemetry.metrics import (  # noqa: F401
     histogram,
     set_enabled,
 )
-from repic_tpu.telemetry.probes import record_transfer  # noqa: F401
+from repic_tpu.telemetry.probes import (  # noqa: F401
+    note_dispatch,
+    record_transfer,
+)
 from repic_tpu.telemetry.sinks import (  # noqa: F401
     METRICS_JSON_NAME,
     METRICS_PROM_NAME,

@@ -121,6 +121,7 @@ _compiles = 0
 _compile_seconds = 0.0
 _transfer_bytes = 0
 _transfer_fetches = 0
+_device_dispatches = 0
 _persistent_hits = 0
 _persistent_hit_seconds = 0.0
 
@@ -193,10 +194,32 @@ def record_transfer(nbytes: int, fetches: int = 1) -> None:
         _transfer_fetches += int(fetches)
 
 
+def note_dispatch(n: int = 1) -> None:
+    """Count ``n`` device-program dispatches.
+
+    Called at this framework's own launch sites (the batched
+    consensus program in ``run_consensus_batch``); like
+    :func:`record_transfer` it is the instrumented lower bound the
+    DISPATCHCHECK sanitizer and ``repic-tpu report`` read — XLA has
+    no portable public dispatch counter.
+    """
+    global _device_dispatches
+    with _lock:
+        _device_dispatches += int(n)
+
+
 def counters() -> tuple[int, int, int]:
     """(compiles, transfer_bytes, transfer_fetches) — the cheap
     cumulative counters spans diff at their boundaries."""
     return _compiles, _transfer_bytes, _transfer_fetches
+
+
+def dispatch_counters() -> tuple[int, int]:
+    """(device_dispatches, transfer_fetches) — the pair a per-chunk
+    dispatch window diffs: instrumented program launches plus fetch
+    round trips, the cost model the <=3-dispatch megakernel budget is
+    written in (docs/observability.md)."""
+    return _device_dispatches, _transfer_fetches
 
 
 def compile_seconds() -> float:
@@ -274,6 +297,7 @@ def snapshot(sample_memory: bool = True) -> dict:
         "compile_seconds": round(_compile_seconds, 6),
         "transfer_bytes": _transfer_bytes,
         "transfer_fetches": _transfer_fetches,
+        "device_dispatches": _device_dispatches,
     }
     if sample_memory:
         mem = device_memory()
@@ -315,6 +339,7 @@ def publish(registry=None, baseline: dict | None = None,
             "compile_seconds",
             "transfer_bytes",
             "transfer_fetches",
+            "device_dispatches",
         ):
             snap[key] = snap[key] - baseline.get(key, 0)
     reg.gauge(
@@ -333,6 +358,10 @@ def publish(registry=None, baseline: dict | None = None,
         "repic_transfer_fetches_total",
         "host<->device round trips at instrumented fetch sites",
     ).set(snap["transfer_fetches"])
+    reg.gauge(
+        "repic_device_dispatches_total",
+        "device-program launches at instrumented dispatch sites",
+    ).set(snap["device_dispatches"])
     if sample_memory:
         reg.gauge(
             "repic_live_buffer_count", "live device arrays at publish"
@@ -355,9 +384,10 @@ def publish(registry=None, baseline: dict | None = None,
 def reset_for_tests() -> None:
     """Zero the cumulative counters (test isolation only)."""
     global _compiles, _compile_seconds
-    global _transfer_bytes, _transfer_fetches
+    global _transfer_bytes, _transfer_fetches, _device_dispatches
     with _lock:
         _compiles = 0
         _compile_seconds = 0.0
         _transfer_bytes = 0
         _transfer_fetches = 0
+        _device_dispatches = 0

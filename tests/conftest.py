@@ -58,8 +58,31 @@ from repic_tpu.analysis import kernelcheck as _kernelcheck
 
 _kernelcheck.maybe_install_from_env()
 
+# Opt-in dispatch-budget sanitizer (REPIC_TPU_DISPATCHCHECK=1): every
+# accepted consensus chunk reports its device-dispatch window
+# (instrumented launches + fetch round trips) against the
+# dispatch_budget= its @checked entry declares — megakernel <=3,
+# staged <=5.  Violations are recorded (never raised) and promoted to
+# a red session by the hooks below — the dynamic cross-check of the
+# static RT512 rule (docs/static_analysis.md "DISPATCHCHECK
+# runbook").  Stdlib-only: safe to arm before jax.
+from repic_tpu.analysis import dispatchcheck as _dispatchcheck
+
+_dispatchcheck.maybe_install_from_env()
+
 import numpy as np
 import pytest
+
+
+@pytest.fixture(autouse=_dispatchcheck.installed())
+def _dispatchcheck_scope(request):
+    """When DISPATCHCHECK is armed, label every chunk window recorded
+    during a test with its nodeid so a violation names its driver."""
+    if not _dispatchcheck.installed():
+        yield
+        return
+    with _dispatchcheck.test_scope(request.node.nodeid):
+        yield
 
 
 @pytest.fixture(scope="session")
@@ -120,13 +143,23 @@ def pytest_terminal_summary(terminalreporter):
             "KERNELCHECK (REPIC_TPU_KERNELCHECK=1)"
         )
         terminalreporter.write_line(_kernelcheck.report_text())
+    if _dispatchcheck.installed():
+        terminalreporter.section(
+            "DISPATCHCHECK (REPIC_TPU_DISPATCHCHECK=1)"
+        )
+        terminalreporter.write_line(_dispatchcheck.report_text())
 
 
 def pytest_sessionfinish(session, exitstatus):
     # A witnessed violation is a red build even if every test passed:
     # the sanitizers record (never raise) so the failure must be
     # promoted here, at session scope.
-    if (_lockcheck.installed() and _lockcheck.violations()) or (
-        _kernelcheck.installed() and _kernelcheck.violations()
+    if (
+        (_lockcheck.installed() and _lockcheck.violations())
+        or (_kernelcheck.installed() and _kernelcheck.violations())
+        or (
+            _dispatchcheck.installed()
+            and _dispatchcheck.violations()
+        )
     ):
         session.exitstatus = 1

@@ -1,0 +1,494 @@
+"""The RT5xx device-cost pass (PR 20 acceptance).
+
+Every rule must fire on a crafted fixture (a pass that silently
+stopped matching would read as a green gate), the RT511 estimator
+must reject a deliberately inflated megakernel envelope, the
+transient formula's edge-count term must match ``ops/cliques``'
+``_edge_pairs``, noqa must suppress on the RT51x anchors (the
+``@checked`` decorator lines and multi-line KernelContract literal
+continuation lines), and the real tree must report clean after the
+sweep — with ``cost_summary`` pinning that the pass still SEES the
+tree's jit entries, contracts, and envelope.
+"""
+
+import os
+import textwrap
+
+from repic_tpu.analysis.cost import (
+    COST_RULES,
+    _envelope_worst_corner,
+    cost_summary,
+    run_cost,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TREE = os.path.join(ROOT, "repic_tpu")
+
+
+def _write(tmp_path, name, source):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source).lstrip("\n"))
+    return str(p)
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- RT501: dispatch chains -------------------------------------------
+
+_STAGED_CHAIN = """
+    import jax
+
+    @jax.jit
+    def stage1(x):
+        return x
+
+    @jax.jit
+    def stage2(x):
+        return x
+
+    @jax.jit
+    def stage3(x):
+        return x
+
+    @jax.jit
+    def stage4(x):
+        return x
+
+    def pipeline(x):
+        a = stage1(x)
+        b = stage2(a)
+        c = stage3(b)
+        d = stage4(c)
+        return d
+    """
+
+
+def test_rt501_fires_on_a_four_program_chain(tmp_path):
+    p = _write(tmp_path, "mod.py", _STAGED_CHAIN)
+    found = [f for f in run_cost([p]) if f.rule == "RT501"]
+    assert found, "a 4-program staged chain must fire RT501"
+    assert "chain" in found[0].message
+
+
+def test_rt501_host_fetch_breaks_the_chain(tmp_path):
+    p = _write(
+        tmp_path,
+        "mod.py",
+        """
+        import jax
+
+        @jax.jit
+        def stage1(x):
+            return x
+
+        @jax.jit
+        def stage2(x):
+            return x
+
+        @jax.jit
+        def stage3(x):
+            return x
+
+        def pipeline(x):
+            a = stage1(x)
+            b = stage2(a)
+            h = float(b)     # host genuinely consumed the value
+            c = stage3(h)
+            return c
+        """,
+    )
+    assert not [f for f in run_cost([p]) if f.rule == "RT501"]
+
+
+def test_rt501_exempts_calls_inside_jitted_functions(tmp_path):
+    # composition INSIDE a trace is fusion, not dispatch — the
+    # lp_device_fused shape: one jitted entry composing many stages
+    p = _write(
+        tmp_path,
+        "mod.py",
+        """
+        import jax
+
+        @jax.jit
+        def s1(x):
+            return x
+
+        @jax.jit
+        def s2(x):
+            return x
+
+        @jax.jit
+        def s3(x):
+            return x
+
+        @jax.jit
+        def fused(x):
+            a = s1(x)
+            b = s2(a)
+            c = s3(b)
+            return c
+        """,
+    )
+    assert not [f for f in run_cost([p]) if f.rule == "RT501"]
+
+
+# -- RT502: loop fetch feedback ---------------------------------------
+
+
+def test_rt502_fires_on_loop_fetch_feeding_device_call(tmp_path):
+    p = _write(
+        tmp_path,
+        "mod.py",
+        """
+        import jax
+
+        @jax.jit
+        def solve(x):
+            return x
+
+        def per_item(items, x):
+            out = []
+            for it in items:
+                y = solve(x).item()
+                out.append(solve(y))
+            return out
+        """,
+    )
+    found = [f for f in run_cost([p]) if f.rule == "RT502"]
+    assert found, "per-item fetch->dispatch loop must fire RT502"
+    assert ".item()" in found[0].message
+
+
+def test_rt502_clean_when_fetch_never_feeds_device(tmp_path):
+    p = _write(
+        tmp_path,
+        "mod.py",
+        """
+        import jax
+
+        @jax.jit
+        def solve(x):
+            return x
+
+        def collect(items, x):
+            out = []
+            for it in items:
+                out.append(solve(x).item())
+            return out
+        """,
+    )
+    assert not [f for f in run_cost([p]) if f.rule == "RT502"]
+
+
+def test_rt502_interprocedural_through_a_builder(tmp_path):
+    # the fetch feeds a plain function that only TRANSITIVELY
+    # dispatches (the make_batched_consensus shape)
+    p = _write(
+        tmp_path,
+        "mod.py",
+        """
+        import jax
+        import numpy as np
+
+        def build(n):
+            return jax.jit(lambda x: x)
+
+        def escalate(x):
+            n = 4
+            while True:
+                fn = build(n)
+                probe = np.asarray(x)
+                n = int(probe.max())
+                fn2 = build(n)
+                break
+            return fn2
+        """,
+    )
+    found = [f for f in run_cost([p]) if f.rule == "RT502"]
+    assert found, "fetch feeding a transitive dispatcher must fire"
+
+
+# -- RT503: unbucketed compile shapes ---------------------------------
+
+
+def test_rt503_fires_on_len_passed_to_jitted_entry(tmp_path):
+    p = _write(
+        tmp_path,
+        "mod.py",
+        """
+        import jax
+
+        @jax.jit
+        def embed(x, n):
+            return x
+
+        def run(data, x):
+            n = len(data)
+            return embed(x, n)
+        """,
+    )
+    found = [f for f in run_cost([p]) if f.rule == "RT503"]
+    assert found, "len() straight into a jitted entry must fire"
+    assert "len()" in found[0].message
+
+
+def test_rt503_washed_by_the_capacity_ladder(tmp_path):
+    p = _write(
+        tmp_path,
+        "mod.py",
+        """
+        import jax
+
+        @jax.jit
+        def embed(x, n):
+            return x
+
+        def _next_bucket(n):
+            b = 2
+            while b < n:
+                b *= 2
+            return b
+
+        def run(data, x):
+            n = _next_bucket(len(data))
+            return embed(x, n)
+        """,
+    )
+    assert not [f for f in run_cost([p]) if f.rule == "RT503"]
+
+
+def test_rt503_exempts_jitted_functions(tmp_path):
+    # in-trace .shape is static by construction
+    p = _write(
+        tmp_path,
+        "mod.py",
+        """
+        import jax
+
+        @jax.jit
+        def inner(x, n):
+            return x
+
+        @jax.jit
+        def outer(x):
+            n = x.shape[0]
+            return inner(x, n)
+        """,
+    )
+    assert not [f for f in run_cost([p]) if f.rule == "RT503"]
+
+
+# -- RT511: static VMEM footprint -------------------------------------
+
+_OVER_BUDGET_CONTRACT = """
+    from repic_tpu.analysis.contracts import Contract, checked
+    from repic_tpu.analysis.kernels import (
+        BlockPlan,
+        KernelContract,
+        KernelPlan,
+    )
+
+    def _plan(dims):
+        n = dims["N"]
+        return KernelPlan(
+            grid=(4,),
+            in_blocks=(
+                BlockPlan("a", (n, 128), lambda i: (i, 0),
+                          (4 * n, 128)),
+            ),
+            out_blocks=(
+                BlockPlan("o", (n, 128), lambda i: (i, 0),
+                          (4 * n, 128)),
+            ),
+        )
+
+    @checked(Contract(
+        args={},
+        returns={},
+        kernel=KernelContract(
+            plan=_plan,
+            ladder=({"N": 1024},),
+            make_inputs=None,
+            reference=None,
+            vmem_budget_bytes=4096,
+        ),
+    ))
+    def kern(x):
+        return x
+    """
+
+
+def test_rt511_fires_on_over_budget_contract(tmp_path):
+    p = _write(tmp_path, "mod.py", _OVER_BUDGET_CONTRACT)
+    found = [f for f in run_cost([p]) if f.rule == "RT511"]
+    assert found, "a (1024,128)x2 double-buffered tile vs a 4 KiB " \
+        "budget must fire RT511"
+    assert "vmem_budget_bytes=4096" in found[0].message
+
+
+def test_rt511_clean_when_budget_covers_the_ladder(tmp_path):
+    src = _OVER_BUDGET_CONTRACT.replace(
+        "vmem_budget_bytes=4096", "vmem_budget_bytes=8 * 2**20"
+    )
+    p = _write(tmp_path, "mod.py", src)
+    assert not [f for f in run_cost([p]) if f.rule == "RT511"]
+
+
+def test_rt511_rejects_an_inflated_fused_envelope(tmp_path):
+    # widening _FUSED_MAX_DPROD without re-deriving the budget math
+    # must fail lint: at K=2 the product dimension alone is 65536
+    # columns -> a ~150 MB transient against a 28 MiB budget
+    p = _write(
+        tmp_path,
+        "mod.py",
+        """
+        _FUSED_MAX_DPROD = 65536
+        _FUSED_MAX_N = 8192
+        _FUSED_MAX_K = 6
+        _DEFAULT_TILE_A = 64
+        FUSED_VMEM_BUDGET_BYTES = 28 * 2**20
+        """,
+    )
+    found = [f for f in run_cost([p]) if f.rule == "RT511"]
+    assert found, "inflated envelope must fire RT511"
+    assert "envelope" in found[0].message
+
+
+def test_rt511_envelope_formula_matches_edge_pairs():
+    # the transient term count E + 2K + 4 hard-codes E = K(K-1)/2
+    # pair columns; pin it against the kernel's actual pair layout
+    from repic_tpu.ops.cliques import _edge_pairs
+
+    for k in range(2, 7):
+        assert k * (k - 1) // 2 == len(_edge_pairs(k))
+
+
+def test_rt511_real_envelope_worst_corner_is_k5():
+    # the documented worst admitted corner: K=5, D=8 (DPROD=4096),
+    # 64 x 4096 x 24 x 4 B = 24 MiB — under the 28 MiB budget but
+    # NOT the K=4 ~18 MB point the original budget math quoted
+    from repic_tpu.ops import megakernel as mk
+
+    k, d, transient = _envelope_worst_corner(
+        mk._FUSED_MAX_DPROD, mk._FUSED_MAX_K, mk._DEFAULT_TILE_A
+    )
+    assert (k, d) == (5, 8)
+    assert transient == 25_165_824
+    assert transient <= mk.FUSED_VMEM_BUDGET_BYTES
+
+
+# -- RT512: declared dispatch budgets ---------------------------------
+
+_BUDGETED = """
+    import jax
+    from repic_tpu.analysis.contracts import Contract, checked
+
+    @jax.jit
+    def prog1(x):
+        return x
+
+    @jax.jit
+    def prog2(x):
+        return x
+
+    @checked(Contract(args={}, returns={}, dispatch_budget=%d))
+    def entry(x):
+        return prog2(prog1(x))
+    """
+
+
+def test_rt512_fires_when_reachable_programs_exceed_budget(tmp_path):
+    p = _write(tmp_path, "mod.py", _BUDGETED % 1)
+    found = [f for f in run_cost([p]) if f.rule == "RT512"]
+    assert found, "2 reachable programs vs budget 1 must fire"
+    assert "dispatch_budget=1" in found[0].message
+    assert "prog1" in found[0].message
+
+
+def test_rt512_clean_within_budget(tmp_path):
+    p = _write(tmp_path, "mod.py", _BUDGETED % 2)
+    assert not [f for f in run_cost([p]) if f.rule == "RT512"]
+
+
+def test_rt512_counts_pallas_sites_outside_jit(tmp_path):
+    p = _write(
+        tmp_path,
+        "mod.py",
+        """
+        from jax.experimental import pallas as pl
+        from repic_tpu.analysis.contracts import Contract, checked
+
+        def _kernel(a_ref, o_ref):
+            o_ref[...] = a_ref[...]
+
+        @checked(Contract(args={}, returns={}, dispatch_budget=0))
+        def entry(x):
+            return pl.pallas_call(_kernel, out_shape=x)(x)
+        """,
+    )
+    found = [f for f in run_cost([p]) if f.rule == "RT512"]
+    assert found, "a pallas_call outside jit is its own launch"
+    assert "pallas" in found[0].message
+
+
+# -- noqa anchoring (RT51x on decorators + multi-line literals) -------
+
+
+def test_rt512_noqa_on_the_decorator_line_suppresses(tmp_path):
+    src = (_BUDGETED % 1).replace(
+        "dispatch_budget=1))",
+        "dispatch_budget=1))  # repic: noqa[RT512]",
+    )
+    p = _write(tmp_path, "mod.py", src)
+    assert not [f for f in run_cost([p]) if f.rule == "RT512"]
+
+
+def test_rt511_noqa_on_a_contract_continuation_line(tmp_path):
+    # the finding anchors on the KernelContract( line; the noqa sits
+    # lines below, on the budget field of the multi-line literal
+    src = _OVER_BUDGET_CONTRACT.replace(
+        "vmem_budget_bytes=4096,",
+        "vmem_budget_bytes=4096,  # repic: noqa[RT511]",
+    )
+    p = _write(tmp_path, "mod.py", src)
+    assert not [f for f in run_cost([p]) if f.rule == "RT511"]
+
+
+# -- select plumbing ---------------------------------------------------
+
+
+def test_select_filters_to_one_rule(tmp_path):
+    p = _write(tmp_path, "mod.py", _STAGED_CHAIN)
+    q = _write(tmp_path, "mod2.py", _BUDGETED % 1)
+    found = run_cost([p, q], select={"RT512"})
+    assert _rules(found) == ["RT512"]
+
+
+def test_cost_rules_registered():
+    assert set(COST_RULES) == {
+        "RT501", "RT502", "RT503", "RT511", "RT512",
+    }
+
+
+# -- real tree: sweep is clean AND the pass is not blind ---------------
+
+
+def test_real_tree_is_clean():
+    findings = run_cost([TREE])
+    assert not findings, "\n".join(
+        f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings
+    )
+
+
+def test_real_tree_non_vacuity():
+    # a refactor that renames @checked / jax.jit / the envelope
+    # constants would silently blind this pass; pin what it sees
+    got = cost_summary([TREE])
+    assert got["jitted_functions"] >= 5
+    assert got["budgeted_entries"] >= 3
+    assert got["kernel_contracts"] >= 3
+    assert got["envelope_modules"] == 1
+    assert got["dispatch_reaching"] >= 10

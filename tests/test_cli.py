@@ -117,6 +117,10 @@ def test_exact_and_greedy_backends_agree_on_objective(tmp_path, rng):
 
 @needs_reference
 def test_fused_matches_two_phase_greedy(tmp_path):
+    """One-command consensus == two-phase get_cliques/run_ilp at the
+    SAME solver.  Both sides pin --solver/--backend greedy: the
+    one-command default is lp_device (PR 18) and the two packers
+    legitimately pick different (equal-weight-class) sets."""
     out_fused = tmp_path / "fused"
     out_two = tmp_path / "two"
     cli_main(
@@ -126,6 +130,8 @@ def test_fused_matches_two_phase_greedy(tmp_path):
             str(out_fused),
             "180",
             "--no_mesh",
+            "--solver",
+            "greedy",
         ]
     )
     cli_main(

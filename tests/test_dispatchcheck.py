@@ -1,0 +1,249 @@
+"""DISPATCHCHECK: the runtime dispatch-budget sanitizer (PR 20).
+
+Unit half: recording, budget lookup from the @checked registry,
+per-test scoping, and report formatting.  Runtime half: a real
+``run_consensus_batch`` chunk must close its accepted-attempt window
+WITHIN the declared budgets (staged <=5 on consensus_one, fused <=3
+on the megakernel entry), an over-budget window must record a
+violation, and the journal must carry the per-chunk
+``chunk_dispatches`` event the window hands off.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+import repic_tpu.ops.megakernel  # noqa: F401 — registers @checked entries
+from repic_tpu.analysis import dispatchcheck
+from repic_tpu.parallel.batching import PaddedBatch
+from repic_tpu.pipeline.consensus import (
+    consume_dispatch_report,
+    run_consensus_batch,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from bench_stress import synthesize  # noqa: E402
+
+FORCE_ENV = "REPIC_TPU_MEGAKERNEL_FORCE"
+STAGED_ENTRY = "repic_tpu.pipeline.consensus.consensus_one"
+FUSED_ENTRY = "repic_tpu.ops.megakernel.fused_clique_candidates"
+
+
+def _batch(m=2, k=3, n=48, seed=0):
+    xy, conf, mask = synthesize(m, k, n, seed=seed)
+    return PaddedBatch(
+        xy=xy, conf=conf, mask=mask,
+        names=tuple(f"m{i}" for i in range(m)),
+        counts=np.full((m, k), n, np.int32),
+    )
+
+
+# -- unit: recording + budgets ----------------------------------------
+
+
+def test_env_gating(monkeypatch):
+    monkeypatch.delenv(dispatchcheck.ENV_VAR, raising=False)
+    assert not dispatchcheck.enabled()
+    with dispatchcheck.scoped():
+        dispatchcheck.uninstall()
+        assert not dispatchcheck.maybe_install_from_env()
+        assert not dispatchcheck.installed()
+        monkeypatch.setenv(dispatchcheck.ENV_VAR, "1")
+        assert dispatchcheck.enabled()
+        assert dispatchcheck.maybe_install_from_env()
+        assert dispatchcheck.installed()
+
+
+def test_budget_comes_from_the_checked_registry():
+    # the budgets the sanitizer enforces ARE the Contract
+    # declarations — no parallel table to drift
+    assert dispatchcheck.budget_for(STAGED_ENTRY) == 5
+    assert dispatchcheck.budget_for(FUSED_ENTRY) == 3
+    assert dispatchcheck.budget_for("no.such.entry") is None
+
+
+def test_within_budget_records_a_window_not_a_violation():
+    with dispatchcheck.scoped():
+        dispatchcheck.reset()
+        dispatchcheck.install()
+        dispatchcheck.note_chunk(STAGED_ENTRY, 2, solver="lp_device")
+        assert len(dispatchcheck.windows()) == 1
+        got = dispatchcheck.windows()[0]
+        assert got["dispatches"] == 2
+        assert got["budget"] == 5
+        assert not dispatchcheck.violations()
+        assert "no violations" in dispatchcheck.report_text()
+
+
+def test_over_budget_records_a_violation():
+    with dispatchcheck.scoped():
+        dispatchcheck.reset()
+        dispatchcheck.install()
+        dispatchcheck.note_chunk(FUSED_ENTRY, 7)
+        vs = dispatchcheck.violations()
+        assert len(vs) == 1
+        assert vs[0]["kind"] == "dispatch-budget-exceeded"
+        assert vs[0]["entry"] == FUSED_ENTRY
+        assert "7" in vs[0]["detail"] and "3" in vs[0]["detail"]
+        assert FUSED_ENTRY in dispatchcheck.report_text()
+
+
+def test_unbudgeted_entry_never_violates():
+    with dispatchcheck.scoped():
+        dispatchcheck.reset()
+        dispatchcheck.install()
+        dispatchcheck.note_chunk("no.such.entry", 1000)
+        assert len(dispatchcheck.windows()) == 1
+        assert not dispatchcheck.violations()
+
+
+def test_disarmed_noting_is_a_noop():
+    with dispatchcheck.scoped():
+        dispatchcheck.reset()
+        dispatchcheck.uninstall()
+        dispatchcheck.note_chunk(FUSED_ENTRY, 100)
+        assert not dispatchcheck.windows()
+        assert not dispatchcheck.violations()
+
+
+def test_test_scope_labels_violations():
+    with dispatchcheck.scoped():
+        dispatchcheck.reset()
+        dispatchcheck.install()
+        with dispatchcheck.test_scope("tests/x.py::test_y"):
+            dispatchcheck.note_chunk(FUSED_ENTRY, 9)
+        assert (
+            dispatchcheck.violations()[0]["test"]
+            == "tests/x.py::test_y"
+        )
+        assert "tests/x.py::test_y" in dispatchcheck.report_text()
+
+
+def test_scoped_restores_prior_state():
+    before_v = dispatchcheck.violations()
+    before_w = dispatchcheck.windows()
+    with dispatchcheck.scoped():
+        dispatchcheck.install()
+        dispatchcheck.note_chunk(FUSED_ENTRY, 50)
+    assert dispatchcheck.violations() == before_v
+    assert dispatchcheck.windows() == before_w
+
+
+# -- runtime: real chunks close within budget -------------------------
+
+
+def test_staged_chunk_within_budget(monkeypatch):
+    monkeypatch.delenv(FORCE_ENV, raising=False)
+    with dispatchcheck.scoped():
+        dispatchcheck.reset()
+        dispatchcheck.install()
+        run_consensus_batch(
+            _batch(seed=1), 180.0, use_mesh=False, solver="lp_device"
+        )
+        assert not dispatchcheck.violations(), (
+            dispatchcheck.report_text()
+        )
+        wins = [
+            w
+            for w in dispatchcheck.windows()
+            if w["entry"] == STAGED_ENTRY
+        ]
+        assert wins, "the staged chunk must close a window"
+        # steady state: one program launch + one probe fetch
+        assert all(w["dispatches"] <= 5 for w in wins)
+
+
+def test_fused_chunk_attributed_to_the_megakernel_entry(monkeypatch):
+    monkeypatch.setenv(FORCE_ENV, "1")
+    with dispatchcheck.scoped():
+        dispatchcheck.reset()
+        dispatchcheck.install()
+        run_consensus_batch(
+            _batch(seed=2), 180.0, use_mesh=False,
+            solver="lp_device_fused", packed_probe=True,
+        )
+        assert not dispatchcheck.violations(), (
+            dispatchcheck.report_text()
+        )
+        wins = [
+            w
+            for w in dispatchcheck.windows()
+            if w["entry"] == FUSED_ENTRY
+        ]
+        assert wins, (
+            "a forced fused chunk must attribute its window to the "
+            f"megakernel entry; got {dispatchcheck.windows()}"
+        )
+        # one fused program + the packed-output fetch
+        assert all(w["dispatches"] <= 3 for w in wins)
+
+
+def test_dispatch_report_hand_off(monkeypatch):
+    monkeypatch.delenv(FORCE_ENV, raising=False)
+    consume_dispatch_report()  # drain any stale slot
+    run_consensus_batch(
+        _batch(seed=3), 180.0, use_mesh=False, solver="greedy"
+    )
+    report = consume_dispatch_report()
+    assert report is not None
+    assert report["entry"] == STAGED_ENTRY
+    assert 1 <= report["dispatches"] <= 5
+    assert report["micrographs"] == 2
+    # the slot is pop-once: the chunk loop journals each window once
+    assert consume_dispatch_report() is None
+
+
+def test_escalation_retries_excluded_from_the_window():
+    # a tiny clique capacity forces at least one escalation retry;
+    # only the ACCEPTED attempt may count against the budget
+    with dispatchcheck.scoped():
+        dispatchcheck.reset()
+        dispatchcheck.install()
+        run_consensus_batch(
+            _batch(m=1, n=64, seed=4), 180.0, use_mesh=False,
+            solver="greedy", clique_capacity=8,
+        )
+        assert not dispatchcheck.violations(), (
+            dispatchcheck.report_text()
+        )
+        assert all(
+            w["dispatches"] <= 5 for w in dispatchcheck.windows()
+        )
+
+
+def test_chunk_dispatches_event_journaled(tmp_path):
+    import json
+
+    from repic_tpu.pipeline.consensus import run_consensus_dir
+
+    rng = np.random.default_rng(5)
+    d = tmp_path / "picks"
+    for p in range(3):
+        (d / f"picker{p}").mkdir(parents=True)
+    base = rng.uniform(50, 950, size=(30, 2))
+    for p in range(3):
+        jit = rng.normal(0, 10, size=base.shape)
+        conf = rng.uniform(0.1, 1.0, size=30)
+        with open(d / f"picker{p}" / "mic0.box", "wt") as f:
+            for (x, y), c in zip(base + jit, conf):
+                f.write(f"{x:.2f}\t{y:.2f}\t64\t64\t{c:.4f}\n")
+    out = tmp_path / "out"
+    run_consensus_dir(
+        str(d), str(out), 64, use_mesh=False, solver="greedy"
+    )
+    journal = out / "_journal.jsonl"
+    assert journal.is_file()
+    events = [
+        json.loads(line)
+        for line in journal.read_text().splitlines()
+        if line.strip()
+    ]
+    disp = [
+        e for e in events if e.get("event") == "chunk_dispatches"
+    ]
+    assert disp, f"no chunk_dispatches event in {events}"
+    assert disp[0]["entry"] == STAGED_ENTRY
+    assert disp[0]["dispatches"] >= 1

@@ -1,0 +1,56 @@
+"""Docs drift gates: tables in docs/ that mirror runtime constants.
+
+A fault site that exists in ``runtime/faults.KNOWN_SITES`` but not in
+the docs table is undocumented (operators can't plan it); a site that
+exists only in the docs silently never fires when planned (the
+``faults.check`` poll is keyed on KNOWN_SITES membership at plan
+validation).  Both directions are drift, both fail here.
+"""
+
+import os
+import re
+
+from repic_tpu.runtime import faults
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ROBUSTNESS = os.path.join(ROOT, "docs", "robustness.md")
+
+
+def _fault_table_sites():
+    text = open(ROBUSTNESS, encoding="utf-8").read()
+    # scope to the fault-injection section: tables elsewhere in the
+    # doc (solver ladder, liveness states) also use backticked first
+    # cells and must not leak in
+    start = text.index("## Fault injection")
+    rest = text[start + 1 :]
+    nxt = rest.find("\n## ")
+    section = rest if nxt < 0 else rest[:nxt]
+    # a site row leads with a backticked name in the first cell;
+    # continuation rows have an empty first cell and prose cells may
+    # mention other sites in backticks — only first cells count
+    return set(
+        re.findall(r"^\| *`([a-z_]+)` *\|", section, flags=re.M)
+    )
+
+
+def test_fault_site_table_matches_known_sites():
+    documented = _fault_table_sites()
+    known = set(faults.KNOWN_SITES)
+    assert documented, "fault table not found in docs/robustness.md"
+    undocumented = known - documented
+    assert not undocumented, (
+        "KNOWN_SITES entries missing from the docs/robustness.md "
+        f"fault table: {sorted(undocumented)}"
+    )
+    phantom = documented - known
+    assert not phantom, (
+        "docs/robustness.md fault table documents sites absent from "
+        f"runtime/faults.KNOWN_SITES (they can never fire): "
+        f"{sorted(phantom)}"
+    )
+
+
+def test_known_sites_have_no_duplicates():
+    # the tuple is the canonical ordered list operators read; a
+    # duplicate would mask a typo'd rename
+    assert len(faults.KNOWN_SITES) == len(set(faults.KNOWN_SITES))

@@ -293,3 +293,80 @@ def test_spmd_pass_imports_no_jax():
     )
     proc = _run(["-c", code])
     assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_list_rules_covers_the_cost_pack():
+    proc = _run(["-m", "repic_tpu.analysis", "--list-rules"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    for rule_id in ("RT501", "RT502", "RT503", "RT511", "RT512"):
+        assert rule_id in proc.stdout, rule_id
+
+
+def test_selecting_an_rt5xx_rule_enables_the_cost_pass(tmp_path):
+    # --select RT501 without --cost must still run the whole-program
+    # pass (a select that silently no-ops reads green)
+    bad = tmp_path / "mod.py"
+    bad.write_text(
+        "import jax\n"
+        "\n"
+        "\n"
+        "@jax.jit\n"
+        "def s1(x):\n"
+        "    return x\n"
+        "\n"
+        "\n"
+        "@jax.jit\n"
+        "def s2(x):\n"
+        "    return x\n"
+        "\n"
+        "\n"
+        "@jax.jit\n"
+        "def s3(x):\n"
+        "    return x\n"
+        "\n"
+        "\n"
+        "def pipeline(x):\n"
+        "    a = s1(x)\n"
+        "    b = s2(a)\n"
+        "    c = s3(b)\n"
+        "    return c\n"
+    )
+    proc = _run(
+        ["-m", "repic_tpu.analysis", str(bad), "--select", "RT501"]
+    )
+    assert proc.returncode == 1, proc.stdout
+    assert "RT501" in proc.stdout
+
+
+def test_lint_help_documents_cost_mode():
+    proc = _run(["-m", "repic_tpu.main", "lint", "--help"])
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "--cost" in proc.stdout
+
+
+def test_check_select_redirects_cost_rules():
+    # `check --select RT511` must not die with "unknown rule" (RT511
+    # findings anchor on @checked/KernelContract lines, so reaching
+    # for the contract checker is the natural mistake) — it points at
+    # the lint --cost surface instead
+    proc = _run(
+        [
+            "-m", "repic_tpu.main", "check",
+            "--select", "RT511", "--list-entries",
+        ]
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "lint --cost" in proc.stderr
+
+
+def test_cost_pass_imports_no_jax():
+    # the RT5xx pass sandboxes KernelContract plans with stdlib
+    # BlockPlan stand-ins precisely so it never needs jax
+    code = (
+        "import sys\n"
+        "from repic_tpu.analysis.cost import run_cost\n"
+        "run_cost([])\n"
+        "assert 'jax' not in sys.modules, 'cost pass imported jax'\n"
+    )
+    proc = _run(["-c", code])
+    assert proc.returncode == 0, proc.stderr[-2000:]
